@@ -1,0 +1,13 @@
+"""singa_tpu: a TPU-native deep learning framework with the capabilities of
+early SINGA (jwmneu/singa), built on JAX/XLA/Pallas.
+
+Layer-DAG models are declared with the reference's text-proto config surface
+(NetProto/LayerProto/UpdaterProto) and compile to a single jitted train step;
+parallelism (DP/TP/PP/SP/EP) is expressed as jax.sharding over a device Mesh.
+"""
+__version__ = "0.1.0"
+
+from .config import (  # noqa: F401
+    ModelConfig, NetConfig, LayerConfig, ParamConfig, UpdaterConfig,
+    ClusterConfig, load_model_config, load_cluster_config,
+)

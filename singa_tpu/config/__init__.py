@@ -1,0 +1,6 @@
+from .schema import (
+    ModelConfig, NetConfig, LayerConfig, ParamConfig, UpdaterConfig,
+    ClusterConfig, ConfigError, load_model_config, load_cluster_config,
+    model_config_from_text, model_config_from_dict,
+)
+from . import textproto

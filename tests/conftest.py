@@ -1,0 +1,10 @@
+import os
+
+# Force a virtual 8-device CPU platform for all tests: sharding/collective
+# tests need a mesh, and unit numerics don't need the real TPU (which is a
+# single chip behind a tunnel in this environment anyway).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

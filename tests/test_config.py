@@ -1,0 +1,119 @@
+"""Config-surface tests: the reference's example configs must load unchanged.
+
+Reference semantics: /root/reference/src/proto/model.proto (field names,
+defaults), examples/mnist/{mlp,conv}.conf (real-world inputs).
+"""
+import os
+
+import pytest
+
+from singa_tpu.config import (
+    ConfigError, load_model_config, load_cluster_config,
+    model_config_from_text,
+)
+from singa_tpu.config import textproto
+
+REF = "/root/reference/examples/mnist"
+
+
+def test_tokenizer_basics():
+    d = textproto.parse('a: 1\nb: "hi"\nc: true\nd: kStep\ne: -0.5  # comment')
+    assert d == {"a": [1], "b": ["hi"], "c": [True], "d": ["kStep"],
+                 "e": [-0.5]}
+
+
+def test_nested_and_repeated():
+    d = textproto.parse("""
+      layer { name: "x" srclayers: "a" srclayers: "b" }
+      layer { name: "y" }
+    """)
+    assert len(d["layer"]) == 2
+    assert d["layer"][0]["srclayers"] == ["a", "b"]
+
+
+def test_colon_optional_before_brace():
+    d = textproto.parse('m: { v: 2 }')
+    assert d["m"][0]["v"] == [2]
+
+
+def test_comment_inside_message():
+    d = textproto.parse('m {\n# hello\nv: 3\n}')
+    assert d["m"][0]["v"] == [3]
+
+
+@pytest.mark.skipif(not os.path.exists(f"{REF}/mlp.conf"),
+                    reason="reference not mounted")
+def test_load_reference_mlp_conf():
+    cfg = load_model_config(f"{REF}/mlp.conf")
+    assert cfg.name == "deep-big-simple-mlp"
+    assert cfg.train_steps == 60000
+    assert cfg.updater.type == "kSGD"
+    assert cfg.updater.learning_rate_change_method == "kStep"
+    assert cfg.updater.base_learning_rate == pytest.approx(0.001)
+    assert cfg.updater.param_type == "Elastic"
+    layers = cfg.neuralnet.layer
+    names = [l.name for l in layers]
+    # two data layers (train/test variants) + mnist/label + 6 fc + 5 tanh + loss
+    assert names.count("data") == 2
+    assert "fc6" in names and "loss" in names
+    fc1 = next(l for l in layers if l.name == "fc1")
+    assert fc1.inner_product_param.num_output == 2500
+    assert fc1.param[0].init_method == "kUniform"
+    assert fc1.param[0].low == pytest.approx(-0.05)
+    loss = next(l for l in layers if l.name == "loss")
+    assert loss.srclayers == ["fc6", "label"]
+    assert loss.softmaxloss_param.topk == 1
+    data_train = layers[0]
+    assert data_train.exclude == ["kTest"]
+    assert data_train.data_param.batchsize == 1000
+    assert data_train.data_param.random_skip == 10000
+
+
+@pytest.mark.skipif(not os.path.exists(f"{REF}/conv.conf"),
+                    reason="reference not mounted")
+def test_load_reference_conv_conf():
+    cfg = load_model_config(f"{REF}/conv.conf")
+    assert cfg.updater.momentum == pytest.approx(0.9)
+    assert cfg.updater.weight_decay == pytest.approx(0.0005)
+    assert cfg.updater.learning_rate_change_method == "kInverse"
+    conv1 = next(l for l in cfg.neuralnet.layer if l.name == "conv1")
+    assert conv1.convolution_param.num_filters == 20
+    assert conv1.convolution_param.kernel == 5
+    assert conv1.param[0].init_method == "kUniformSqrtFanIn"
+    assert conv1.param[1].learning_rate_multiplier == pytest.approx(2.0)
+    pool1 = next(l for l in cfg.neuralnet.layer if l.name == "pool1")
+    assert pool1.pooling_param.pool == "MAX"
+    assert pool1.pooling_param.stride == 2
+    mnist = next(l for l in cfg.neuralnet.layer if l.name == "mnist")
+    assert mnist.mnist_param.norm_a == 255
+
+
+@pytest.mark.skipif(not os.path.exists(f"{REF}/cluster.conf"),
+                    reason="reference not mounted")
+def test_load_reference_cluster_conf():
+    cfg = load_cluster_config(f"{REF}/cluster.conf")
+    assert cfg.nworkers >= 1
+
+
+def test_defaults_match_reference_proto():
+    cfg = model_config_from_text("name: 'm' updater { type: kSGD "
+                                 "base_learning_rate: 0.1 }")
+    u = cfg.updater
+    assert u.hogwild is True
+    assert u.delta == pytest.approx(1e-7)
+    assert u.rho == pytest.approx(0.9)
+    assert u.sync_frequency == 1
+    assert u.warmup_steps == 10
+    assert u.param_type == "Elastic"
+    assert cfg.prefetch is True
+    assert cfg.alg == "kBackPropagation"
+
+
+def test_unknown_field_rejected():
+    with pytest.raises(ConfigError):
+        model_config_from_text("bogus_field: 3")
+
+
+def test_bad_enum_rejected():
+    with pytest.raises(ConfigError):
+        model_config_from_text("updater { type: kBogus }")
