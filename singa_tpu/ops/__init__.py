@@ -1,0 +1,7 @@
+from .activations import relu, sigmoid, tanh, stanh, softplus, bnll
+from .conv import conv2d, im2col, conv_out_size
+from .pool import max_pool2d, avg_pool2d, pooled_size
+from .lrn import lrn
+from .loss import softmax_cross_entropy, topk_precision, softmax_loss_metrics
+from .dropout import dropout
+from .linear import linear

@@ -1,0 +1,49 @@
+"""Elementwise activations with reference numerics.
+
+Reference: /root/reference/include/mshadow/cxxnet_op.h:14-113.  The
+reference computes gradients from the layer *output* (e.g. tanh_grad(y) =
+1 - y**2); those formulas are the exact derivatives of the forward
+functions, so `jax.grad` through these plain definitions reproduces the
+reference backward pass — no custom VJPs needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# scaled-tanh constants, cxxnet_op.h:77-81 (LeCun's 1.7159 * tanh(2x/3))
+STANH_OUTER = 1.7159047
+STANH_INNER = 0.66666667
+
+
+def relu(x, negative_slope: float = 0.0):
+    """cxxnet_op.h:26-30; ReLUProto.negative_slope (leaky) model.proto:268-275."""
+    if negative_slope:
+        return jnp.where(x > 0, x, negative_slope * x)
+    return jnp.maximum(x, 0.0)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def stanh(x, outer_scale: float = STANH_OUTER, inner_scale: float = STANH_INNER):
+    """Scaled tanh A*tanh(B*x). Defaults are the reference's hard-coded
+    constants (cxxnet_op.h:77-81); TanhProto outer/inner_scale override."""
+    return outer_scale * jnp.tanh(inner_scale * x)
+
+
+def softplus(x):
+    """cxxnet_op.h:48-52 log(1+exp(x)), numerically stabilized."""
+    return jax.nn.softplus(x)
+
+
+def bnll(x):
+    """Binomial negative log-likelihood, cxxnet_op.h:58-62 (caffe BNLL):
+    x>0 ? x + log(1+exp(-x)) : log(1+exp(x)) — the stable softplus."""
+    return jax.nn.softplus(x)

@@ -1,0 +1,76 @@
+"""2-D convolution, TPU-native.
+
+The reference (layer.cc:63-123) lowers conv to a per-batch-item
+im2col (`unpack_patch2col`) followed by a gemm against a weight of shape
+(num_filters, C*k*k).  On TPU the idiomatic form is a single
+`lax.conv_general_dilated` which XLA tiles directly onto the MXU — one
+fused op for the whole batch, with the backward passes derived by
+autodiff (XLA emits the transposed/grad convs).
+
+We keep the reference's *weight layout* (num_filters, C*k*k) as the
+stored parameter so partition semantics (ParamProto.partition_dim) and
+checkpoints line up with the config surface; it is reshaped to OIHW at
+trace time (free at compile time).
+
+`im2col` is also provided as a reference oracle for golden tests.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+_DIMNUMS = ("NCHW", "OIHW", "NCHW")
+
+
+def conv_out_size(size: int, kernel: int, stride: int, pad: int) -> int:
+    """Reference formula layer.cc:37-38: (h + 2p - k)/s + 1 (floor)."""
+    return (size + 2 * pad - kernel) // stride + 1
+
+
+def conv2d(x: jnp.ndarray, weight: jnp.ndarray, bias=None, *,
+           kernel: int, stride: int = 1, pad: int = 0,
+           channels: int | None = None) -> jnp.ndarray:
+    """x: (N, C, H, W); weight: (num_filters, C*k*k) reference layout.
+
+    Returns (N, num_filters, H', W').
+    """
+    n, c, h, w = x.shape
+    if channels is None:
+        channels = c
+    num_filters = weight.shape[0]
+    wk = weight.reshape(num_filters, channels, kernel, kernel)
+    out = lax.conv_general_dilated(
+        x, wk,
+        window_strides=(stride, stride),
+        padding=[(pad, pad), (pad, pad)],
+        dimension_numbers=_DIMNUMS,
+        preferred_element_type=jnp.float32,
+    )
+    if bias is not None:
+        out = out + bias.reshape(1, num_filters, 1, 1)
+    return out
+
+
+def im2col(img: jnp.ndarray, kernel: int, stride: int) -> jnp.ndarray:
+    """`unpack_patch2col` oracle (tensor_expr_ext.h:38-73 semantics).
+
+    img: (C, H, W) → (C*k*k, H'*W') where row index = c*k*k + ki*k + kj
+    (channel-major, then kernel row, then kernel col) matching the
+    reference's col layout so weight @ col reproduces conv.
+    """
+    c, h, w = img.shape
+    oh = (h - kernel) // stride + 1
+    ow = (w - kernel) // stride + 1
+    patches = []
+    for ci in range(c):
+        for ki in range(kernel):
+            for kj in range(kernel):
+                sub = lax.slice(img, (ci, ki, kj),
+                                (ci + 1, ki + (oh - 1) * stride + 1,
+                                 kj + (ow - 1) * stride + 1),
+                                (1, stride, stride))
+                patches.append(sub.reshape(-1))
+    return jnp.stack(patches)

@@ -1,0 +1,21 @@
+"""Dropout, reference numerics (layer.cc:126-160).
+
+mask = 1[uniform < pkeep] / pkeep; y = x * mask.  Same mask reused by
+the backward pass — which is exactly what autodiff through the masked
+multiply produces.  RNG is an explicit JAX key (the reference seeds a
+global mt19937 from the clock; here determinism is first-class).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def dropout(x: jnp.ndarray, rate: float, rng: jax.Array,
+            train: bool = True) -> jnp.ndarray:
+    if not train or rate <= 0.0:
+        return x
+    pkeep = 1.0 - rate
+    mask = (jax.random.uniform(rng, x.shape) < pkeep).astype(x.dtype) / pkeep
+    return x * mask

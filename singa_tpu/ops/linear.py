@@ -1,0 +1,20 @@
+"""Inner-product (fully-connected) op.
+
+Reference: layer.cc:162-213 — weight (vdim, hdim), y = x @ W + bias
+(bias broadcast over batch via repmat).  On TPU this is a single gemm on
+the MXU; grads (x^T g, sum_rows g, g W^T — layer.cc:199-211) come from
+autodiff and lower to the same two gemms.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def linear(x: jnp.ndarray, weight: jnp.ndarray, bias=None) -> jnp.ndarray:
+    """x: (B, ...) flattened to (B, vdim); weight: (vdim, hdim)."""
+    x = x.reshape(x.shape[0], -1)
+    y = jnp.dot(x, weight, preferred_element_type=jnp.float32)
+    if bias is not None:
+        y = y + bias
+    return y

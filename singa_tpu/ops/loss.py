@@ -1,0 +1,45 @@
+"""Softmax cross-entropy loss + top-k precision metric.
+
+Reference: layer.cc:702-765 (SoftmaxLossLayer) —
+  forward: prob = softmax(logits); loss = scale * mean(-log prob[label]);
+           precision = scale * mean(label in top-k(prob))
+  backward: gsrc = (prob - onehot(label)) * scale / batch
+The loss here is written in the numerically-stable logsumexp form whose
+exact gradient is the reference's backward formula, so one `jax.grad`
+reproduces it.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
+                          scale: float = 1.0) -> jnp.ndarray:
+    """logits: (B, D) float; labels: (B,) int. Returns scalar mean NLL*scale."""
+    logits = logits.reshape(logits.shape[0], -1)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    label_logit = jnp.take_along_axis(
+        logits, labels.astype(jnp.int32)[:, None], axis=-1)[:, 0]
+    return scale * jnp.mean(lse - label_logit)
+
+
+def topk_precision(logits: jnp.ndarray, labels: jnp.ndarray, topk: int = 1,
+                   scale: float = 1.0) -> jnp.ndarray:
+    """Fraction of rows whose true label is in the top-k logits."""
+    logits = logits.reshape(logits.shape[0], -1)
+    _, idx = jax.lax.top_k(logits, topk)
+    hit = jnp.any(idx == labels.astype(jnp.int32)[:, None], axis=-1)
+    return scale * jnp.mean(hit.astype(jnp.float32))
+
+
+def softmax_loss_metrics(logits: jnp.ndarray, labels: jnp.ndarray,
+                         topk: int = 1, scale: float = 1.0
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(loss, precision) — the reference's metric_ blob layout
+    (layer.cc:749-751: metric[0]=loss, metric[1]=precision)."""
+    return (softmax_cross_entropy(logits, labels, scale),
+            topk_precision(logits, labels, topk, scale))

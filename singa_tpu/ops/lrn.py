@@ -1,0 +1,32 @@
+"""Local response normalization (cross-channel), reference numerics.
+
+Reference: layer.cc:331-378 —
+    norm = chpool_sum(x^2, lsize) * (alpha/lsize) + knorm
+    y    = x * norm^(-beta)
+where chpool sums x^2 over a channel window of lsize centered at each
+channel (zero-padded).  Backward is derived by autodiff; the reference's
+hand-written gradient (layer.cc:366-377) is the exact derivative of this
+forward, so the numerics match.
+
+On TPU: a windowed sum over the channel axis — one `lax.reduce_window`
+that XLA fuses with the surrounding elementwise ops.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def lrn(x: jnp.ndarray, local_size: int = 5, alpha: float = 1.0,
+        beta: float = 0.75, knorm: float = 1.0) -> jnp.ndarray:
+    """x: (N, C, H, W) cross-channel LRN."""
+    half = local_size // 2
+    sq = x * x
+    norm = lax.reduce_window(
+        sq, 0.0, lax.add,
+        window_dimensions=(1, local_size, 1, 1),
+        window_strides=(1, 1, 1, 1),
+        padding=((0, 0), (half, half), (0, 0), (0, 0)))
+    norm = norm * (alpha / local_size) + knorm
+    return x * (norm ** -beta)

@@ -1,0 +1,48 @@
+"""Pooling with the reference's caffe-style ceil-mode geometry.
+
+Reference: layer.cc:476-540 — pooled = ceil((h - k)/s) + 1; AVE divides
+by k*k regardless of window clipping; MAX backward routes gradient to
+max positions (mshadow `unpool<red::maximum>`).  On TPU this is one
+`lax.reduce_window` (XLA lowers to a fused windowed reduction); the
+backward comes from autodiff, which reproduces unpool semantics.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def pooled_size(size: int, kernel: int, stride: int) -> int:
+    """layer.cc:497-500: ceil((size - kernel)/stride) + 1."""
+    return int(math.ceil((size - kernel) / stride)) + 1
+
+
+def _ceil_pad(size: int, kernel: int, stride: int) -> int:
+    out = pooled_size(size, kernel, stride)
+    return max(0, (out - 1) * stride + kernel - size)
+
+
+def max_pool2d(x: jnp.ndarray, kernel: int, stride: int) -> jnp.ndarray:
+    """x: (N, C, H, W). Ceil-mode max pool."""
+    n, c, h, w = x.shape
+    ph, pw = _ceil_pad(h, kernel, stride), _ceil_pad(w, kernel, stride)
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max,
+        window_dimensions=(1, 1, kernel, kernel),
+        window_strides=(1, 1, stride, stride),
+        padding=((0, 0), (0, 0), (0, ph), (0, pw)))
+
+
+def avg_pool2d(x: jnp.ndarray, kernel: int, stride: int) -> jnp.ndarray:
+    """Ceil-mode average pool dividing by k*k always (layer.cc:513-515)."""
+    n, c, h, w = x.shape
+    ph, pw = _ceil_pad(h, kernel, stride), _ceil_pad(w, kernel, stride)
+    s = lax.reduce_window(
+        x, 0.0, lax.add,
+        window_dimensions=(1, 1, kernel, kernel),
+        window_strides=(1, 1, stride, stride),
+        padding=((0, 0), (0, 0), (0, ph), (0, pw)))
+    return s * (1.0 / (kernel * kernel))
