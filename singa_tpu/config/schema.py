@@ -57,10 +57,12 @@ def _build(cls, raw: Dict[str, List[Any]], path: str):
         ftype = f.metadata.get("msg")
         repeated = f.metadata.get("repeated", False)
         if ftype is not None:
-            conv = [
-                _build(ftype, v, f"{path}.{name}") if isinstance(v, dict) else v
-                for v in values
-            ]
+            for v in values:
+                if not isinstance(v, dict):
+                    raise ConfigError(
+                        f"{path}: field '{name}' expects a "
+                        f"{ftype.__name__} message block, got scalar {v!r}")
+            conv = [_build(ftype, v, f"{path}.{name}") for v in values]
         else:
             conv = values
         if repeated:
@@ -72,14 +74,13 @@ def _build(cls, raw: Dict[str, List[Any]], path: str):
     return cls(**kwargs)
 
 
-def _msg(cls, repeated=False, **kw):
-    default = kw.pop("default", None)
+def _msg(cls, repeated=False):
     if repeated:
         return field(default_factory=list, metadata={"msg": cls, "repeated": True})
-    return field(default=default, metadata={"msg": cls})
+    return field(default=None, metadata={"msg": cls})
 
 
-def _rep(**kw):
+def _rep():
     return field(default_factory=list, metadata={"repeated": True})
 
 
@@ -129,6 +130,10 @@ class LRNConfig:
     norm_region: str = "ACROSS_CHANNELS"
     knorm: float = 1.0
 
+    def __post_init__(self):
+        if self.norm_region not in LRN_NORM_REGIONS:
+            raise ConfigError(f"bad norm_region {self.norm_region!r}")
+
 
 @dataclass
 class MnistConfig:
@@ -149,6 +154,10 @@ class PoolingConfig:
     kernel: int = 0
     pad: int = 0
     stride: int = 1
+
+    def __post_init__(self):
+        if self.pool not in POOL_METHODS:
+            raise ConfigError(f"bad pool method {self.pool!r}")
 
 
 @dataclass

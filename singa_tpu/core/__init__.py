@@ -1,0 +1,7 @@
+from .init import init_param
+from .updater import Updater, Multipliers, learning_rate, make_updater
+from .graph import Graph, GraphError
+from .layers import (Layer, LayerError, ParamSpec, Context, create_layer,
+                     register_layer, LAYER_REGISTRY)
+from .net import NeuralNet, build_net
+from .trainer import Trainer, Performance, TimerInfo

@@ -1,0 +1,490 @@
+"""The layer zoo: reference layer types as pure JAX compute.
+
+Each reference Layer subclass (base_layer.h:38-563, layer.h:28-291) maps
+to a registry entry here with three duties:
+
+  setup(src_shapes)   — shape inference + param spec declaration
+                        (reference Layer::Setup)
+  apply(params, srcs, ctx) — forward compute (reference ComputeFeature);
+                        the backward (ComputeGradient) comes from jax.grad.
+
+The whole net therefore compiles to one XLA program per phase instead of
+a hand-scheduled per-layer interpreter loop.
+
+Layer `type` strings are the reference's registry keys
+(neuralnet.cc:13-44): kConvolution, kPooling, kLRN, kInnerProduct,
+kReLU, kTanh, kSigmoid, kDropout, kSoftmaxLoss, kMnistImage, kRGBImage,
+kLabel, kShardData, kLMDBData, kConcate, kSlice, kSplit, kBridgeSrc,
+kBridgeDst — plus TPU-native modern types (kEmbed, kAttention, kRMSNorm,
+kMoE, kRBM) registered by their model families.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import ops
+from ..config.schema import LayerConfig, ParamConfig
+
+
+class LayerError(ValueError):
+    pass
+
+
+@dataclass
+class ParamSpec:
+    name: str           # global key: "<layer>/<param-name>"
+    shape: Tuple[int, ...]
+    fan_in: int
+    cfg: ParamConfig
+    # sharding hint: ParamProto.partition_dim (-1 = replicate)
+    partition_dim: int = -1
+
+
+@dataclass
+class Context:
+    """Per-call state threaded through Layer.apply."""
+    batch: Dict[str, Any]
+    train: bool
+    rng: Optional[jax.Array] = None
+    layer_index: int = 0
+
+    def layer_rng(self) -> jax.Array:
+        if self.rng is None:
+            raise LayerError("layer needs an rng but none was provided")
+        return jax.random.fold_in(self.rng, self.layer_index)
+
+
+LAYER_REGISTRY: Dict[str, type] = {}
+
+
+def register_layer(type_name: str):
+    def deco(cls):
+        LAYER_REGISTRY[type_name] = cls
+        cls.type_name = type_name
+        return cls
+    return deco
+
+
+class Layer:
+    """Base layer. Subclasses fill out_shape and param_specs in setup()."""
+
+    is_data = False     # True → reads from ctx.batch, has no srcs
+    is_loss = False     # True → apply returns a metrics dict incl. "loss"
+
+    def __init__(self, cfg: LayerConfig):
+        self.cfg = cfg
+        self.name = cfg.name
+        self.out_shape: Any = None
+        self.param_specs: List[ParamSpec] = []
+
+    def setup(self, src_shapes: List[Any]) -> None:
+        raise NotImplementedError
+
+    def apply(self, params: Dict[str, jnp.ndarray], srcs: List[Any],
+              ctx: Context) -> Any:
+        raise NotImplementedError
+
+    # -- helpers ----------------------------------------------------------
+    def _param_cfg(self, i: int, default_name: str) -> ParamConfig:
+        if i < len(self.cfg.param):
+            return self.cfg.param[i]
+        return ParamConfig(name=default_name)
+
+    def _declare(self, i: int, default_name: str, shape, fan_in: int,
+                 partition_dim: int = -1) -> str:
+        pcfg = self._param_cfg(i, default_name)
+        pname = pcfg.name or default_name
+        key = f"{self.name}/{pname}"
+        if pcfg.partition_dim != -1:
+            partition_dim = pcfg.partition_dim
+        self.param_specs.append(
+            ParamSpec(key, tuple(shape), fan_in, pcfg, partition_dim))
+        return key
+
+
+# ---------------------------------------------------------------------------
+# data / parser layers
+
+
+@register_layer("kShardData")
+class ShardDataLayer(Layer):
+    """Input layer (layer.cc:646-673): emits the raw record batch provided
+    by the host input pipeline via ctx.batch[self.name]."""
+
+    is_data = True
+
+    def setup(self, src_shapes, sample_shapes: Optional[Dict] = None):
+        bs = self.cfg.data_param.batchsize if self.cfg.data_param else 0
+        self.batchsize = bs
+        self.sample_shapes = sample_shapes or {}
+        self.out_shape = {k: (bs,) + tuple(v)
+                          for k, v in self.sample_shapes.items()}
+
+    def apply(self, params, srcs, ctx):
+        try:
+            return ctx.batch[self.name]
+        except KeyError:
+            raise LayerError(
+                f"batch missing entry for data layer {self.name!r}; "
+                f"have {list(ctx.batch)}")
+
+
+@register_layer("kLMDBData")
+class LMDBDataLayer(ShardDataLayer):
+    """LMDB-backed data layer (layer.cc:237-328). Device-side it is
+    identical to ShardData: the host pipeline supplies the batch."""
+
+
+@register_layer("kMnistImage")
+class MnistImageLayer(Layer):
+    """Parser (layer.cc:380-473): uint8 pixels → (x/norm_a - norm_b),
+    output (B, s, s).  The reference does this per-pixel on the host; here
+    it runs inside the jitted step (zero CPU in the inner loop)."""
+
+    def setup(self, src_shapes):
+        p = self.cfg.mnist_param
+        self.norm_a = p.norm_a if p else 1.0
+        self.norm_b = p.norm_b if p else 0.0
+        pix = src_shapes[0]["pixel"]
+        self.out_shape = tuple(pix)
+
+    def apply(self, params, srcs, ctx):
+        x = srcs[0]["pixel"].astype(jnp.float32)
+        return x / self.norm_a - self.norm_b
+
+
+@register_layer("kRGBImage")
+class RGBImageLayer(Layer):
+    """Parser (layer.cc:571-643): mean-subtract, random crop + mirror in
+    training / center crop in eval, scale. Output (B, 3, crop, crop)."""
+
+    def setup(self, src_shapes):
+        p = self.cfg.rgbimage_param
+        self.scale = p.scale if p else 1.0
+        self.cropsize = p.cropsize if p else 0
+        self.mirror = bool(p.mirror) if p else False
+        shape = list(src_shapes[0]["pixel"])  # (B, C, H, W)
+        if self.cropsize:
+            shape[2] = shape[3] = self.cropsize
+        self.out_shape = tuple(shape)
+
+    def apply(self, params, srcs, ctx):
+        x = srcs[0]["pixel"].astype(jnp.float32)
+        mean = srcs[0].get("mean")
+        if mean is not None:
+            x = x - mean
+        b, c, h, w = x.shape
+        cs = self.cropsize
+        if cs and (h > cs or w > cs):
+            if ctx.train:
+                rng = ctx.layer_rng()
+                r1, r2, r3 = jax.random.split(rng, 3)
+                oh = jax.random.randint(r1, (), 0, h - cs + 1)
+                ow = jax.random.randint(r2, (), 0, w - cs + 1)
+                x = jax.lax.dynamic_slice(x, (0, 0, oh, ow), (b, c, cs, cs))
+                if self.mirror:
+                    flip = jax.random.bernoulli(r3)
+                    x = jnp.where(flip, x[..., ::-1], x)
+            else:
+                oh, ow = (h - cs) // 2, (w - cs) // 2
+                x = x[:, :, oh:oh + cs, ow:ow + cs]
+        return x * self.scale
+
+
+@register_layer("kLabel")
+class LabelLayer(Layer):
+    """Parser (layer.cc:416-432): int labels, shape (B,)."""
+
+    def setup(self, src_shapes):
+        self.out_shape = tuple(src_shapes[0]["label"])
+
+    def apply(self, params, srcs, ctx):
+        return srcs[0]["label"]
+
+
+# ---------------------------------------------------------------------------
+# neuron layers
+
+
+def _nchw_shape(shape):
+    """Reference conv/pool accept 3-D (B,H,W) inputs as single-channel
+    (layer.cc:31-36)."""
+    if len(shape) == 3:
+        return (shape[0], 1, shape[1], shape[2])
+    return tuple(shape)
+
+
+def _as_nchw(x):
+    if x.ndim == 3:
+        return x.reshape(x.shape[0], 1, x.shape[1], x.shape[2])
+    return x
+
+
+@register_layer("kConvolution")
+class ConvolutionLayer(Layer):
+    """layer.cc:26-123. Weight kept in the reference layout
+    (num_filters, C*k*k); compute is one lax.conv_general_dilated."""
+
+    def setup(self, src_shapes):
+        p = self.cfg.convolution_param
+        if p is None or not p.kernel:
+            raise LayerError(f"{self.name}: convolution_param.kernel required")
+        b, c, h, w = _nchw_shape(src_shapes[0])
+        self.channels, self.height, self.width = c, h, w
+        self.kernel, self.stride, self.pad = p.kernel, p.stride, p.pad
+        self.num_filters = p.num_filters
+        self.bias_term = p.bias_term
+        ch = ops.conv_out_size(h, p.kernel, p.stride, p.pad)
+        cw = ops.conv_out_size(w, p.kernel, p.stride, p.pad)
+        self.out_shape = (b, p.num_filters, ch, cw)
+        col_height = c * p.kernel * p.kernel
+        self.w_key = self._declare(0, "weight", (p.num_filters, col_height),
+                                   fan_in=col_height, partition_dim=0)
+        if self.bias_term:
+            self.b_key = self._declare(1, "bias", (p.num_filters,), fan_in=0,
+                                       partition_dim=0)
+
+    def apply(self, params, srcs, ctx):
+        x = _as_nchw(srcs[0])
+        bias = params[self.b_key] if self.bias_term else None
+        return ops.conv2d(x, params[self.w_key], bias, kernel=self.kernel,
+                          stride=self.stride, pad=self.pad,
+                          channels=self.channels)
+
+
+@register_layer("kPooling")
+class PoolingLayer(Layer):
+    def setup(self, src_shapes):
+        p = self.cfg.pooling_param
+        if p is None or not p.kernel:
+            raise LayerError(f"{self.name}: pooling_param.kernel required")
+        if p.pool not in ("MAX", "AVE"):
+            raise LayerError(f"{self.name}: bad pool method {p.pool!r}")
+        b, c, h, w = _nchw_shape(src_shapes[0])
+        self.kernel, self.stride, self.mode = p.kernel, p.stride, p.pool
+        self.out_shape = (b, c, ops.pooled_size(h, p.kernel, p.stride),
+                          ops.pooled_size(w, p.kernel, p.stride))
+
+    def apply(self, params, srcs, ctx):
+        x = _as_nchw(srcs[0])
+        if self.mode == "MAX":
+            return ops.max_pool2d(x, self.kernel, self.stride)
+        return ops.avg_pool2d(x, self.kernel, self.stride)
+
+
+@register_layer("kLRN")
+class LRNLayer(Layer):
+    def setup(self, src_shapes):
+        p = self.cfg.lrn_param
+        self.local_size = p.local_size if p else 5
+        if self.local_size % 2 != 1:
+            raise LayerError(f"{self.name}: LRN local_size must be odd")
+        self.alpha = p.alpha if p else 1.0
+        self.beta = p.beta if p else 0.75
+        self.knorm = p.knorm if p else 1.0
+        self.out_shape = tuple(src_shapes[0])
+
+    def apply(self, params, srcs, ctx):
+        return ops.lrn(srcs[0], self.local_size, self.alpha, self.beta,
+                       self.knorm)
+
+
+@register_layer("kInnerProduct")
+class InnerProductLayer(Layer):
+    """layer.cc:162-213: flatten to (B, vdim), weight (vdim, hdim).
+    NOTE the reference passes fan_in = vdim*hdim to Param::Setup
+    (layer.cc:174) — reproduced for init parity."""
+
+    def setup(self, src_shapes):
+        p = self.cfg.inner_product_param
+        if p is None or not p.num_output:
+            raise LayerError(f"{self.name}: inner_product_param.num_output "
+                             "required")
+        s = tuple(src_shapes[0])
+        b = s[0]
+        vdim = int(math.prod(s[1:]))
+        hdim = p.num_output
+        self.bias_term = p.bias_term
+        self.out_shape = (b, hdim)
+        self.w_key = self._declare(0, "weight", (vdim, hdim),
+                                   fan_in=vdim * hdim, partition_dim=1)
+        if self.bias_term:
+            self.b_key = self._declare(1, "bias", (hdim,), fan_in=0,
+                                       partition_dim=0)
+
+    def apply(self, params, srcs, ctx):
+        bias = params[self.b_key] if self.bias_term else None
+        return ops.linear(srcs[0], params[self.w_key], bias)
+
+
+@register_layer("kReLU")
+class ReLULayer(Layer):
+    def setup(self, src_shapes):
+        self.slope = (self.cfg.relu_param.negative_slope
+                      if self.cfg.relu_param else 0.0)
+        self.out_shape = tuple(src_shapes[0])
+
+    def apply(self, params, srcs, ctx):
+        return ops.relu(srcs[0], self.slope)
+
+
+@register_layer("kTanh")
+class TanhLayer(Layer):
+    """Reference kTanh is the *scaled* tanh stanh (layer.cc:688-701) with
+    hard-coded constants; TanhProto outer/inner_scale override them."""
+
+    def setup(self, src_shapes):
+        p = self.cfg.tanh_param
+        if p is not None:
+            self.outer, self.inner = p.outer_scale, p.inner_scale
+        else:
+            self.outer, self.inner = ops.activations.STANH_OUTER, \
+                ops.activations.STANH_INNER
+        self.out_shape = tuple(src_shapes[0])
+
+    def apply(self, params, srcs, ctx):
+        return ops.stanh(srcs[0], self.outer, self.inner)
+
+
+@register_layer("kSigmoid")
+class SigmoidLayer(Layer):
+    def setup(self, src_shapes):
+        self.out_shape = tuple(src_shapes[0])
+
+    def apply(self, params, srcs, ctx):
+        return ops.sigmoid(srcs[0])
+
+
+@register_layer("kDropout")
+class DropoutLayer(Layer):
+    def setup(self, src_shapes):
+        self.rate = (self.cfg.dropout_param.dropout_ratio
+                     if self.cfg.dropout_param else 0.5)
+        self.out_shape = tuple(src_shapes[0])
+
+    def apply(self, params, srcs, ctx):
+        if not ctx.train:
+            return srcs[0]
+        return ops.dropout(srcs[0], self.rate, ctx.layer_rng(), train=True)
+
+
+# ---------------------------------------------------------------------------
+# loss layers
+
+
+@register_layer("kSoftmaxLoss")
+class SoftmaxLossLayer(Layer):
+    """layer.cc:702-765: fused softmax + NLL + top-k precision.
+    srcs = [logits, label]."""
+
+    is_loss = True
+
+    def setup(self, src_shapes):
+        p = self.cfg.softmaxloss_param
+        self.topk = p.topk if p else 1
+        self.scale = p.scale if p else 1.0
+        self.out_shape = (2,)   # metric blob layout [loss, precision]
+
+    def apply(self, params, srcs, ctx):
+        logits, labels = srcs
+        loss, prec = ops.softmax_loss_metrics(logits, labels, self.topk,
+                                              self.scale)
+        return {"loss": loss, "precision": prec}
+
+
+# ---------------------------------------------------------------------------
+# connector layers (partition infrastructure, base_layer.h:264-330 +
+# base_layer.cc:39-194). Under GSPMD these are mostly identities or plain
+# jnp ops — data movement is compiled in from sharding annotations.
+
+
+@register_layer("kConcate")
+class ConcateLayer(Layer):
+    def setup(self, src_shapes):
+        dim = (self.cfg.concate_param.concate_dimension
+               if self.cfg.concate_param else 0)
+        self.dim = dim
+        shape = list(src_shapes[0])
+        shape[dim] = sum(s[dim] for s in src_shapes)
+        self.out_shape = tuple(shape)
+
+    def apply(self, params, srcs, ctx):
+        return jnp.concatenate(srcs, axis=self.dim)
+
+
+@register_layer("kSlice")
+class SliceLayer(Layer):
+    """Scatter along slice_dimension into slice_num views; consumer i
+    reads view i (base_layer.cc:114-173). Output is the tuple of views."""
+
+    def setup(self, src_shapes):
+        p = self.cfg.slice_param
+        self.dim = p.slice_dimension if p else 0
+        self.num = p.slice_num if p else 1
+        s = list(src_shapes[0])
+        base, rem = divmod(s[self.dim], self.num)
+        shapes = []
+        for i in range(self.num):
+            # reference gives the remainder to the last partition
+            # (neuralnet.cc:160-162 semantics)
+            sz = base + (rem if i == self.num - 1 else 0)
+            t = list(s)
+            t[self.dim] = sz
+            shapes.append(tuple(t))
+        self.out_shape = tuple(shapes)
+
+    def apply(self, params, srcs, ctx):
+        x = srcs[0]
+        base = x.shape[self.dim] // self.num
+        outs = []
+        start = 0
+        for i in range(self.num):
+            sz = (x.shape[self.dim] - start if i == self.num - 1 else base)
+            idx = [slice(None)] * x.ndim
+            idx[self.dim] = slice(start, start + sz)
+            outs.append(x[tuple(idx)])
+            start += sz
+        return tuple(outs)
+
+
+@register_layer("kSplit")
+class SplitLayer(Layer):
+    """Replicate to multiple consumers (base_layer.h:316-330) — a pure
+    identity under functional semantics."""
+
+    def setup(self, src_shapes):
+        self.out_shape = tuple(src_shapes[0])
+
+    def apply(self, params, srcs, ctx):
+        return srcs[0]
+
+
+@register_layer("kBridgeSrc")
+class BridgeSrcLayer(Layer):
+    """Cross-location activation sender (base_layer.h:264-312). Under
+    GSPMD the transfer is a compiled collective; the layer is an identity
+    marker kept for config parity."""
+
+    def setup(self, src_shapes):
+        self.out_shape = tuple(src_shapes[0])
+
+    def apply(self, params, srcs, ctx):
+        return srcs[0]
+
+
+@register_layer("kBridgeDst")
+class BridgeDstLayer(BridgeSrcLayer):
+    pass
+
+
+def create_layer(cfg: LayerConfig) -> Layer:
+    if cfg.type not in LAYER_REGISTRY:
+        raise LayerError(f"unknown layer type {cfg.type!r} "
+                         f"(registered: {sorted(LAYER_REGISTRY)})")
+    return LAYER_REGISTRY[cfg.type](cfg)

@@ -1,0 +1,202 @@
+"""NeuralNet: NetProto config → a single pure, jittable step function.
+
+Reference: /root/reference/src/worker/neuralnet.cc.  Same construction
+semantics — graph from `srclayers` edges, topological sort
+(neuralnet.cc:72-110, graph.cc:80-101), per-phase layer filtering by
+`exclude` (worker.cc:72-86), Setup() shape inference in topo order —
+but instead of an interpreter walking layers per step, the whole forward
+(+ loss) is a pure function of (params, batch) that `jax.grad` and
+`jax.jit` turn into one compiled XLA program.  Weight sharing between
+train/test nets (neuralnet.cc:379-391 ShareWeights) is implicit: both
+phases apply different nets to the *same* params pytree.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config.schema import LayerConfig, ModelConfig, NetConfig
+from .graph import Graph
+from .init import init_param
+from .layers import Context, Layer, LayerError, ParamSpec, create_layer
+from .updater import Multipliers
+
+
+class NeuralNet:
+    def __init__(self, net_cfg: NetConfig, phase: str = "kTrain",
+                 input_shapes: Optional[Dict[str, Dict[str, tuple]]] = None,
+                 batchsize: Optional[int] = None):
+        """input_shapes: data-layer name → field → per-sample shape
+        (no batch dim), e.g. {"data": {"pixel": (28, 28), "label": ()}}.
+        `batchsize` overrides DataProto.batchsize for all data layers.
+        """
+        self.phase = phase
+        self.cfgs: List[LayerConfig] = [
+            l for l in net_cfg.layer if phase not in l.exclude]
+        self.input_shapes = input_shapes or {}
+        self.batchsize_override = batchsize
+
+        self.graph = Graph()
+        for l in self.cfgs:
+            self.graph.add_node(l.name, type=l.type)
+        names = {l.name for l in self.cfgs}
+        for l in self.cfgs:
+            for src in l.srclayers:
+                if src not in names:
+                    raise LayerError(
+                        f"layer {l.name!r}: unknown srclayer {src!r} "
+                        f"in phase {phase}")
+                self.graph.add_edge(src, l.name)
+        self.topo = self.graph.topo_sort()
+
+        self.layers: Dict[str, Layer] = {
+            l.name: create_layer(l) for l in self.cfgs}
+        self._setup()
+        self._build_param_index()
+
+    # -- construction ------------------------------------------------------
+    def _setup(self) -> None:
+        shapes: Dict[str, Any] = {}
+        for name in self.topo:
+            layer = self.layers[name]
+            src_shapes = [self._src_shape(shapes, src, name)
+                          for src in layer.cfg.srclayers]
+            if layer.is_data:
+                sample = self.input_shapes.get(name)
+                if sample is None:
+                    raise LayerError(
+                        f"data layer {name!r} needs input_shapes entry")
+                layer.setup(src_shapes, sample_shapes=sample)
+                if self.batchsize_override:
+                    layer.batchsize = self.batchsize_override
+                    layer.out_shape = {
+                        k: (self.batchsize_override,) + tuple(v)
+                        for k, v in sample.items()}
+            else:
+                layer.setup(src_shapes)
+            shapes[name] = layer.out_shape
+        self.shapes = shapes
+
+    def _src_shape(self, shapes: Dict[str, Any], src: str, dst: str):
+        out = shapes[src]
+        if isinstance(out, tuple) and out and isinstance(out[0], tuple):
+            # Slice layer: consumer i gets view i (base_layer.cc:114-173)
+            return out[self._consumer_index(src, dst)]
+        return out
+
+    def _consumer_index(self, src: str, dst: str) -> int:
+        return self.graph.dsts_of(src).index(dst)
+
+    def _build_param_index(self) -> None:
+        self.param_specs: Dict[str, ParamSpec] = {}
+        self.param_aliases: Dict[str, str] = {}
+        for name in self.topo:
+            layer = self.layers[name]
+            shared = list(layer.cfg.share_param)
+            for i, spec in enumerate(layer.param_specs):
+                if i < len(shared):
+                    # share_param: this layer's i-th param aliases another
+                    # layer's param (model.proto:137); key is the canonical
+                    # "<layer>/<name>" of the owner.
+                    self.param_aliases[spec.name] = shared[i]
+                else:
+                    self.param_specs[spec.name] = spec
+
+    # -- params ------------------------------------------------------------
+    def init_params(self, rng: jax.Array) -> Dict[str, jnp.ndarray]:
+        params = {}
+        keys = jax.random.split(rng, max(len(self.param_specs), 1))
+        for k, (name, spec) in zip(keys, sorted(self.param_specs.items())):
+            params[name] = init_param(k, spec.cfg, spec.shape, spec.fan_in)
+        return params
+
+    def multipliers(self) -> Dict[str, Multipliers]:
+        return {name: Multipliers(spec.cfg.learning_rate_multiplier,
+                                  spec.cfg.weight_decay_multiplier)
+                for name, spec in self.param_specs.items()}
+
+    def partition_dims(self) -> Dict[str, int]:
+        """ParamProto.partition_dim per param — consumed by
+        singa_tpu.parallel.partition to build NamedShardings."""
+        return {name: spec.partition_dim
+                for name, spec in self.param_specs.items()}
+
+    def _resolve_params(self, params: Dict[str, jnp.ndarray]):
+        if not self.param_aliases:
+            return params
+        full = dict(params)
+        for alias, owner in self.param_aliases.items():
+            if owner not in full:
+                raise LayerError(f"share_param target {owner!r} not found")
+            full[alias] = full[owner]
+        return full
+
+    # -- forward -----------------------------------------------------------
+    def apply(self, params: Dict[str, jnp.ndarray], batch: Dict[str, Any],
+              rng: Optional[jax.Array] = None, train: Optional[bool] = None
+              ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray], Dict[str, Any]]:
+        """Run the net. Returns (total_loss, metrics, outputs).
+
+        metrics aggregates every loss layer's dict (the reference's
+        Performance blob, worker.cc:350-386); outputs maps layer name →
+        activation (the reference's per-layer data_ blobs).
+        """
+        if train is None:
+            train = self.phase == "kTrain"
+        full = self._resolve_params(params)
+        ctx_batch = batch
+        outputs: Dict[str, Any] = {}
+        metrics: Dict[str, jnp.ndarray] = {}
+        total_loss = jnp.zeros((), jnp.float32)
+        for idx, name in enumerate(self.topo):
+            layer = self.layers[name]
+            srcs = [self._src_out(outputs, src, name)
+                    for src in layer.cfg.srclayers]
+            ctx = Context(batch=ctx_batch, train=train, rng=rng,
+                          layer_index=idx)
+            out = layer.apply(full, srcs, ctx)
+            outputs[name] = out
+            if layer.is_loss:
+                total_loss = total_loss + out["loss"]
+                for k, v in out.items():
+                    key = k if len(self._loss_layers()) == 1 else f"{name}/{k}"
+                    metrics[key] = v
+        return total_loss, metrics, outputs
+
+    def _src_out(self, outputs, src, dst):
+        from .layers import SliceLayer
+        out = outputs[src]
+        if isinstance(self.layers[src], SliceLayer):
+            return out[self._consumer_index(src, dst)]
+        return out
+
+    def _loss_layers(self) -> List[str]:
+        return [n for n in self.topo if self.layers[n].is_loss]
+
+    # -- introspection -----------------------------------------------------
+    def to_json(self) -> str:
+        """Net-structure dump for visualization (graph.cc:4-59 parity)."""
+        return self.graph.to_json()
+
+    def debug_info(self, params: Dict[str, jnp.ndarray],
+                   outputs: Dict[str, Any]) -> str:
+        """Per-layer mean-absolute data norms — the reference's DebugInfo
+        printout (neuralnet.cc:350-378) used when ModelProto.debug."""
+        lines = []
+        for name in self.topo:
+            out = outputs.get(name)
+            if isinstance(out, jnp.ndarray) and out.dtype != jnp.int32:
+                lines.append(f"{name}: data {jnp.mean(jnp.abs(out)):.6f}")
+        for pname, p in sorted(params.items()):
+            lines.append(f"{pname}: param {jnp.mean(jnp.abs(p)):.6f}")
+        return "\n".join(lines)
+
+
+def build_net(model_cfg: ModelConfig, phase: str = "kTrain",
+              input_shapes=None, batchsize=None) -> NeuralNet:
+    if model_cfg.neuralnet is None:
+        raise LayerError("model config has no neuralnet section")
+    return NeuralNet(model_cfg.neuralnet, phase, input_shapes, batchsize)

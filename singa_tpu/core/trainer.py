@@ -1,0 +1,206 @@
+"""Trainer: the TPU-native Worker (reference src/worker/worker.cc).
+
+The reference Worker spawns Executor threads that walk the layer DAG,
+block on bridges/param versions, and push gradients at a ZMQ parameter
+server.  Here the entire TrainOneBatch (worker.cc:187-316) — forward,
+backward, and updater — is ONE jitted function; data parallelism is a
+mesh sharding over the batch dim with XLA inserting the gradient psum
+(see singa_tpu.parallel), so there is no parameter-server plane and no
+CPU compute in the inner loop.
+
+Cadence semantics preserved from ModelProto (model.proto:2-47):
+  train_steps, test_steps, test_frequency/test_after_steps,
+  validation_*, display_*; Performance metric averaging over the display
+  interval (worker.cc:350-386); per-phase wall-time report in the style
+  of TimerInfo (worker.h:91-114).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config.schema import ModelConfig
+from .net import NeuralNet, build_net
+from .updater import Updater, make_updater
+
+
+@dataclass
+class Performance:
+    """Metric aggregation over an interval (worker.cc:350-386)."""
+    totals: Dict[str, float] = field(default_factory=dict)
+    counter: int = 0
+
+    def update(self, metrics: Dict[str, jnp.ndarray]) -> None:
+        for k, v in metrics.items():
+            self.totals[k] = self.totals.get(k, 0.0) + float(v)
+        self.counter += 1
+
+    def to_string(self) -> str:
+        n = max(self.counter, 1)
+        return ", ".join(f"{k} : {v / n:.6f}"
+                         for k, v in sorted(self.totals.items()))
+
+    def averages(self) -> Dict[str, float]:
+        n = max(self.counter, 1)
+        return {k: v / n for k, v in self.totals.items()}
+
+    def reset(self) -> None:
+        self.totals.clear()
+        self.counter = 0
+
+
+@dataclass
+class TimerInfo:
+    """Per-phase wall-time accumulator (worker.h:91-114)."""
+    times: Dict[str, float] = field(default_factory=dict)
+    steps: int = 0
+
+    def add(self, phase: str, seconds: float) -> None:
+        self.times[phase] = self.times.get(phase, 0.0) + seconds
+
+    def to_string(self) -> str:
+        total = sum(self.times.values()) or 1.0
+        parts = [f"{k}: {v / max(self.steps, 1) * 1e3:.2f}ms "
+                 f"({100 * v / total:.0f}%)"
+                 for k, v in self.times.items()]
+        return "Time per step — " + ", ".join(parts)
+
+    def reset(self) -> None:
+        self.times.clear()
+        self.steps = 0
+
+
+class Trainer:
+    """Single-controller training driver.
+
+    `data_factory(phase, net)` must return an iterator of batch dicts
+    matching the net's data layers (see singa_tpu.data.pipeline).
+    """
+
+    def __init__(self, model_cfg: ModelConfig,
+                 input_shapes: Dict[str, Dict[str, tuple]],
+                 log_fn: Callable[[str], None] = print,
+                 donate: bool = True):
+        self.cfg = model_cfg
+        self.log = log_fn
+        self.train_net = build_net(model_cfg, "kTrain", input_shapes)
+        self.test_net = self._maybe_net("kTest", input_shapes)
+        self.val_net = self._maybe_net("kValidation", input_shapes)
+        self.updater = make_updater(model_cfg.updater)
+        self.multipliers = self.train_net.multipliers()
+        self._build_steps(donate)
+        self.perf = Performance()
+        self.timer = TimerInfo()
+
+    def _maybe_net(self, phase: str, input_shapes) -> Optional[NeuralNet]:
+        try:
+            net = build_net(self.cfg, phase, input_shapes)
+        except Exception:
+            return None
+        return net if net._loss_layers() else None
+
+    # -- compiled steps ----------------------------------------------------
+    def _build_steps(self, donate: bool) -> None:
+        net, updater, mults = self.train_net, self.updater, self.multipliers
+
+        def train_step(params, opt_state, batch, step, rng):
+            def loss_fn(p):
+                loss, metrics, _ = net.apply(p, batch, rng=rng, train=True)
+                return loss, metrics
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            params, opt_state = updater.update(step, grads, params, opt_state,
+                                               multipliers=mults)
+            return params, opt_state, metrics
+
+        donate_args = (0, 1) if donate else ()
+        self.train_step = jax.jit(train_step, donate_argnums=donate_args)
+
+        def make_eval(net):
+            def eval_step(params, batch):
+                _, metrics, _ = net.apply(params, batch, train=False)
+                return metrics
+            return jax.jit(eval_step)
+
+        self.test_step = make_eval(self.test_net) if self.test_net else None
+        self.val_step = make_eval(self.val_net) if self.val_net else None
+
+    # -- init --------------------------------------------------------------
+    def init(self, seed: int = 0):
+        rng = jax.random.PRNGKey(seed)
+        params = self.train_net.init_params(rng)
+        opt_state = self.updater.init(params)
+        return params, opt_state
+
+    # -- cadence helpers (worker.h:127-160 semantics) ----------------------
+    def _now(self, step, freq, after) -> bool:
+        return freq > 0 and step >= after and step % freq == 0
+
+    def display_now(self, step):
+        return self._now(step, self.cfg.display_frequency,
+                         self.cfg.display_after_steps)
+
+    def test_now(self, step):
+        return self._now(step, self.cfg.test_frequency,
+                         self.cfg.test_after_steps)
+
+    def validate_now(self, step):
+        return self._now(step, self.cfg.validation_frequency,
+                         self.cfg.validation_after_steps)
+
+    # -- loops -------------------------------------------------------------
+    def evaluate(self, params, data_iter: Iterator, steps: int,
+                 step_fn) -> Dict[str, float]:
+        perf = Performance()
+        for _ in range(max(steps, 1)):
+            batch = next(data_iter)
+            perf.update(jax.device_get(step_fn(params, batch)))
+        return perf.averages()
+
+    def run(self, params, opt_state,
+            train_iter: Iterator,
+            test_iter_factory: Optional[Callable[[], Iterator]] = None,
+            val_iter_factory: Optional[Callable[[], Iterator]] = None,
+            start_step: int = 0, seed: int = 0,
+            hooks: Optional[List[Callable[[int, Dict], None]]] = None):
+        """The Worker::Run loop (worker.cc:98-106)."""
+        rng = jax.random.PRNGKey(seed ^ 0x5eed)
+        history: List[Dict[str, float]] = []
+        for step in range(start_step, self.cfg.train_steps):
+            if self.val_step and self.validate_now(step) and val_iter_factory:
+                avg = self.evaluate(params, val_iter_factory(),
+                                    self.cfg.validation_steps, self.val_step)
+                self.log(f"step-{step} validation: " + ", ".join(
+                    f"{k} : {v:.6f}" for k, v in sorted(avg.items())))
+            if self.test_step and self.test_now(step) and test_iter_factory:
+                avg = self.evaluate(params, test_iter_factory(),
+                                    self.cfg.test_steps, self.test_step)
+                self.log(f"step-{step} test: " + ", ".join(
+                    f"{k} : {v:.6f}" for k, v in sorted(avg.items())))
+                history.append({"step": step, **avg})
+
+            t0 = time.perf_counter()
+            batch = next(train_iter)
+            t1 = time.perf_counter()
+            step_rng = jax.random.fold_in(rng, step)
+            params, opt_state, metrics = self.train_step(
+                params, opt_state, batch, step, step_rng)
+            metrics = jax.device_get(metrics)
+            t2 = time.perf_counter()
+            self.timer.add("data", t1 - t0)
+            self.timer.add("train", t2 - t1)
+            self.timer.steps += 1
+            self.perf.update(metrics)
+            if hooks:
+                for h in hooks:
+                    h(step, metrics)
+            if self.display_now(step):
+                self.log(f"step-{step}: {self.perf.to_string()}")
+                self.log(self.timer.to_string())
+                self.perf.reset()
+        return params, opt_state, history

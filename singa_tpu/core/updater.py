@@ -1,0 +1,185 @@
+"""Updaters (SGD-family optimizers) + learning-rate schedules.
+
+Reference: /root/reference/src/utils/updater.cc.  Formula parity notes:
+
+- LR schedules (updater.cc:11-51): kFixed, kLinear, kExponential,
+  kInverse_t, kInverse, kStep.  kStep uses C++ *integer* division
+  step/freq; kLinear/kExponential use float division.
+- SGDUpdater (updater.cc:62-79): wd folded into grad, then
+  history = momentum*history + lr*grad; data -= history (or plain
+  data -= lr*grad when momentum == 0).
+- NesterovUpdater (:89-105): data -= (1+mu)*h_new - mu*h_old.
+- AdaGrad (:115-128): history += (grad*grad_scale)^2 BEFORE the wd fold;
+  data -= lr*(grad + wd*data)/sqrt(history + delta).
+- RMSProp (:140-153): history = rho*history + (1-rho)*(grad*scale)^2,
+  same wd placement as AdaGrad.
+- AdaDelta (:163-182): wd folded first; no lr (schedule unused);
+  tmp = grad*sqrt(update+delta)/sqrt(history+delta).
+
+All state (history/update) is zero-initialized, which reproduces the
+reference's `if(step==0) history=0` reset.  Per-param
+learning_rate_multiplier / weight_decay_multiplier come from
+ParamProto (model.proto:103-105).
+
+The whole update is pure pytree math — it runs inside the jitted train
+step, fused by XLA into the backward pass (the TPU-native replacement
+for the reference's ParamManager update loop, param_manager.cc:160-199).
+
+TPU-native additions: kAdam, kCosine / kWarmupCosine schedules.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config.schema import UpdaterConfig
+
+
+def learning_rate(cfg: UpdaterConfig, step) -> jnp.ndarray:
+    """GetLearningRate (updater.cc:11-51), jit-traceable in `step`."""
+    base = cfg.base_learning_rate
+    method = cfg.learning_rate_change_method
+    step = jnp.asarray(step, jnp.float32)
+    if method == "kFixed":
+        return jnp.asarray(base, jnp.float32)
+    if method == "kLinear":
+        r = step / cfg.learning_rate_change_frequency
+        return (1.0 - r) * base + r * cfg.final_learning_rate
+    if method == "kExponential":
+        return base / jnp.power(2.0, step / cfg.learning_rate_change_frequency)
+    if method == "kInverse_t":
+        return base / (1.0 + step / cfg.final_learning_rate)
+    if method == "kInverse":
+        return base * jnp.power(1.0 + cfg.gamma * step, -cfg.pow)
+    if method == "kStep":
+        # C++ integer division step/freq (updater.cc:41-45)
+        return base * jnp.power(
+            cfg.gamma, jnp.floor(step / cfg.learning_rate_change_frequency))
+    if method == "kCosine":
+        t = jnp.clip(step / max(cfg.learning_rate_change_frequency, 1), 0, 1)
+        return cfg.final_learning_rate + 0.5 * (base - cfg.final_learning_rate) * (
+            1.0 + jnp.cos(jnp.pi * t))
+    if method == "kWarmupCosine":
+        warm = max(cfg.warmup_steps, 1)
+        total = max(cfg.learning_rate_change_frequency, warm + 1)
+        t = jnp.clip((step - warm) / (total - warm), 0, 1)
+        cos_lr = cfg.final_learning_rate + 0.5 * (
+            base - cfg.final_learning_rate) * (1.0 + jnp.cos(jnp.pi * t))
+        return jnp.where(step < warm, base * (step + 1) / warm, cos_lr)
+    raise ValueError(f"unknown LR schedule {method!r}")
+
+
+class Multipliers(NamedTuple):
+    """Per-param static multipliers (ParamProto lr/wd multipliers)."""
+    lr: float = 1.0
+    wd: float = 1.0
+
+
+class Updater:
+    """Functional updater over a param pytree.
+
+    state = self.init(params); params, state = self.update(step, grads,
+    params, state).  `multipliers` is a pytree matching `params` whose
+    leaves are `Multipliers` (defaults to all-ones).
+    """
+
+    def __init__(self, cfg: UpdaterConfig):
+        self.cfg = cfg
+        self.type = cfg.type
+
+    # -- state ------------------------------------------------------------
+    def init(self, params) -> Dict[str, Any]:
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        state: Dict[str, Any] = {"history": zeros}
+        if self.type in ("kAdaDelta", "kAdam"):
+            state["update"] = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return state
+
+    # -- update -----------------------------------------------------------
+    def update(self, step, grads, params, state,
+               multipliers=None, grad_scale: float = 1.0):
+        cfg = self.cfg
+        if multipliers is None:
+            multipliers = jax.tree_util.tree_map(
+                lambda _: Multipliers(), params,
+                is_leaf=lambda x: isinstance(x, jnp.ndarray))
+        lr = learning_rate(cfg, step) if cfg.base_learning_rate else 0.0
+
+        def leaves(*trees):
+            return [jax.tree_util.tree_leaves(
+                t, is_leaf=lambda x: isinstance(x, Multipliers))
+                for t in trees]
+
+        treedef = jax.tree_util.tree_structure(params)
+
+        p_l, g_l, m_l = leaves(params, grads, multipliers)
+        h_l = jax.tree_util.tree_leaves(state["history"])
+        u_l = (jax.tree_util.tree_leaves(state["update"])
+               if "update" in state else [None] * len(p_l))
+
+        new_p, new_h, new_u = [], [], []
+        for p, g, h, u, m in zip(p_l, g_l, h_l, u_l, m_l):
+            plr = lr * m.lr
+            pwd = cfg.weight_decay * m.wd
+            np_, nh, nu = self._apply_one(step, p, g, h, u, plr, pwd,
+                                          grad_scale)
+            new_p.append(np_)
+            new_h.append(nh)
+            new_u.append(nu)
+
+        new_state = {"history": jax.tree_util.tree_unflatten(treedef, new_h)}
+        if "update" in state:
+            new_state["update"] = jax.tree_util.tree_unflatten(treedef, new_u)
+        return jax.tree_util.tree_unflatten(treedef, new_p), new_state
+
+    def _apply_one(self, step, p, g, h, u, lr, wd, grad_scale):
+        cfg = self.cfg
+        t = self.type
+        if t == "kSGD":
+            if wd > 0:
+                g = g + p * wd
+            if cfg.momentum > 0:
+                h = h * cfg.momentum + lr * g
+                return p - h, h, u
+            return p - lr * g, h, u
+        if t == "kNesterov":
+            if wd > 0:
+                g = g + p * wd
+            h_old = h
+            h = h * cfg.momentum + lr * g
+            return p - (h * (1 + cfg.momentum) - h_old * cfg.momentum), h, u
+        if t == "kAdaGrad":
+            h = h + jnp.square(g * grad_scale)
+            if wd > 0:
+                g = g + p * wd
+            return p - lr * g / jnp.sqrt(h + cfg.delta), h, u
+        if t == "kRMSProp":
+            h = h * cfg.rho + (1 - cfg.rho) * jnp.square(g * grad_scale)
+            if wd > 0:
+                g = g + p * wd
+            return p - lr * g / jnp.sqrt(h + cfg.delta), h, u
+        if t == "kAdaDelta":
+            if wd > 0:
+                g = g + p * wd
+            h = h * cfg.rho + (1 - cfg.rho) * jnp.square(g * grad_scale)
+            tmp = g * jnp.sqrt(u + cfg.delta) / jnp.sqrt(h + cfg.delta)
+            u = cfg.rho * u + (1 - cfg.rho) * jnp.square(tmp)
+            return p - tmp, h, u
+        if t == "kAdam":
+            if wd > 0:
+                g = g + p * wd
+            b1, b2 = cfg.beta1, cfg.beta2
+            h = b1 * h + (1 - b1) * g          # first moment
+            u = b2 * u + (1 - b2) * jnp.square(g)  # second moment
+            tstep = jnp.asarray(step, jnp.float32) + 1.0
+            mhat = h / (1 - b1 ** tstep)
+            vhat = u / (1 - b2 ** tstep)
+            return p - lr * mhat / (jnp.sqrt(vhat) + cfg.delta), h, u
+        raise ValueError(f"unknown updater type {t!r}")
+
+
+def make_updater(cfg: Optional[UpdaterConfig]) -> Updater:
+    return Updater(cfg if cfg is not None else UpdaterConfig(type="kSGD"))

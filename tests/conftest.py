@@ -1,10 +1,21 @@
 import os
 
-# Force a virtual 8-device CPU platform for all tests: sharding/collective
-# tests need a mesh, and unit numerics don't need the real TPU (which is a
-# single chip behind a tunnel in this environment anyway).
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Tests run on a virtual 8-device CPU platform: sharding/collective tests
+# need a mesh, and unit numerics want CPU float32 (the real hardware here
+# is a single TPU chip behind the experimental `axon` platform, whose
+# interpreter-startup hook pins jax_platforms="axon,cpu" via jax.config —
+# env vars alone cannot override it, so we update the config directly).
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+from jax.extend.backend import clear_backends  # noqa: E402
+
+clear_backends()  # no-op when nothing initialized yet
+
+assert jax.default_backend() == "cpu", jax.default_backend()
+assert len(jax.devices()) == 8, jax.devices()

@@ -1,0 +1,216 @@
+"""NeuralNet builder tests: reference configs → compiled train steps."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from singa_tpu.config import load_model_config, model_config_from_text
+from singa_tpu.core import build_net, Trainer
+from singa_tpu.core.graph import Graph, GraphError
+
+MNIST_SHAPES = {"data": {"pixel": (28, 28), "label": ()}}
+
+
+def _mnist_batch(bs, rng, size=28, nclass=10):
+    return {"data": {
+        "pixel": jnp.asarray(
+            rng.integers(0, 256, (bs, size, size)).astype(np.uint8)),
+        "label": jnp.asarray(rng.integers(0, nclass, (bs,))),
+    }}
+
+
+def test_graph_topo_and_cycle():
+    g = Graph()
+    for n in "abc":
+        g.add_node(n)
+    g.add_edge("a", "b")
+    g.add_edge("b", "c")
+    assert g.topo_sort() == ["a", "b", "c"]
+    g.add_edge("c", "a")
+    with pytest.raises(GraphError):
+        g.topo_sort()
+
+
+def test_build_mlp_from_reference_conf():
+    cfg = load_model_config("/root/reference/examples/mnist/mlp.conf")
+    net = build_net(cfg, "kTrain", MNIST_SHAPES, batchsize=8)
+    # phase filtering: only one data layer remains
+    assert [n for n in net.topo if n == "data"] == ["data"]
+    # shapes through the stack
+    assert net.shapes["mnist"] == (8, 28, 28)
+    assert net.shapes["fc1"] == (8, 2500)
+    assert net.shapes["fc6"] == (8, 10)
+    # 6 fc layers × (weight+bias)
+    assert len(net.param_specs) == 12
+    assert net.param_specs["fc1/weight"].shape == (784, 2500)
+
+    rng = np.random.default_rng(0)
+    params = net.init_params(jax.random.PRNGKey(0))
+    loss, metrics, outputs = net.apply(params, _mnist_batch(8, rng))
+    assert np.isfinite(float(loss))
+    assert 0.0 <= float(metrics["precision"]) <= 1.0
+    # uniform(-0.05, 0.05) init → initial loss near log(10)
+    assert abs(float(loss) - np.log(10)) < 0.5
+
+
+def test_build_lenet_from_reference_conf():
+    cfg = load_model_config("/root/reference/examples/mnist/conv.conf")
+    net = build_net(cfg, "kTrain", MNIST_SHAPES, batchsize=4)
+    assert net.shapes["conv1"] == (4, 20, 24, 24)
+    assert net.shapes["pool1"] == (4, 20, 12, 12)
+    assert net.shapes["conv2"] == (4, 50, 8, 8)
+    assert net.shapes["pool2"] == (4, 50, 4, 4)
+    assert net.shapes["ip1"] == (4, 500)
+    assert net.shapes["ip2"] == (4, 10)
+    assert net.param_specs["conv1/weight"].shape == (20, 25)
+    assert net.param_specs["conv2/weight"].shape == (50, 20 * 25)
+
+    rng = np.random.default_rng(1)
+    params = net.init_params(jax.random.PRNGKey(1))
+    loss, metrics, _ = net.apply(params, _mnist_batch(4, rng))
+    assert np.isfinite(float(loss))
+
+
+def test_test_phase_net_shares_params():
+    cfg = load_model_config("/root/reference/examples/mnist/mlp.conf")
+    train_net = build_net(cfg, "kTrain", MNIST_SHAPES, batchsize=8)
+    test_net = build_net(cfg, "kTest", MNIST_SHAPES, batchsize=8)
+    # same param specs → same pytree works for both (ShareWeights parity)
+    assert set(train_net.param_specs) == set(test_net.param_specs)
+    params = train_net.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(2)
+    loss, _, _ = test_net.apply(params, _mnist_batch(8, rng), train=False)
+    assert np.isfinite(float(loss))
+
+
+def test_trainer_loss_decreases_on_fixed_batch():
+    """End-to-end smoke: jitted train step memorizes one batch."""
+    cfg = load_model_config("/root/reference/examples/mnist/conv.conf")
+    cfg.train_steps = 30
+    cfg.test_frequency = 0
+    cfg.display_frequency = 0
+    trainer = Trainer(cfg, MNIST_SHAPES)
+    params, opt_state = trainer.init(seed=0)
+    rng = np.random.default_rng(3)
+    batch = _mnist_batch(16, rng)
+
+    losses = []
+    for step in range(60):
+        params, opt_state, metrics = trainer.train_step(
+            params, opt_state, batch, step, jax.random.PRNGKey(step))
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.7, losses[::10]
+
+
+def test_share_param_aliasing():
+    text = """
+    neuralnet {
+      layer { name: "data" type: "kShardData"
+              data_param { batchsize: 4 } }
+      layer { name: "img" type: "kMnistImage" srclayers: "data" }
+      layer { name: "lab" type: "kLabel" srclayers: "data" }
+      layer { name: "fc1" type: "kInnerProduct" srclayers: "img"
+              inner_product_param { num_output: 784 }
+              param { name: "w" init_method: kUniform low: -0.1 high: 0.1 }
+              param { name: "b" init_method: kConstant value: 0 } }
+      layer { name: "fc2" type: "kInnerProduct" srclayers: "fc1"
+              inner_product_param { num_output: 784 }
+              share_param: "fc1/w"
+              param { name: "w2" }
+              param { name: "b2" init_method: kConstant value: 0 } }
+      layer { name: "loss" type: "kSoftmaxLoss"
+              srclayers: "fc2" srclayers: "lab" }
+    }
+    """
+    cfg = model_config_from_text(text)
+    net = build_net(cfg, "kTrain", MNIST_SHAPES)
+    assert "fc2/w2" not in net.param_specs
+    assert net.param_aliases == {"fc2/w2": "fc1/w"}
+    params = net.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(4)
+    loss, _, _ = net.apply(params, _mnist_batch(4, rng))
+    assert np.isfinite(float(loss))
+
+
+def test_connector_layers_concate_slice_split():
+    text = """
+    neuralnet {
+      layer { name: "data" type: "kShardData"
+              data_param { batchsize: 6 } }
+      layer { name: "img" type: "kMnistImage" srclayers: "data" }
+      layer { name: "lab" type: "kLabel" srclayers: "data" }
+      layer { name: "split" type: "kSplit" srclayers: "img"
+              split_param { num_splits: 2 } }
+      layer { name: "fc_a" type: "kInnerProduct" srclayers: "split"
+              inner_product_param { num_output: 8 }
+              param { name: "weight" init_method: kUniform }
+              param { name: "bias" init_method: kConstant value: 0 } }
+      layer { name: "fc_b" type: "kInnerProduct" srclayers: "split"
+              inner_product_param { num_output: 8 }
+              param { name: "weight" init_method: kUniform }
+              param { name: "bias" init_method: kConstant value: 0 } }
+      layer { name: "cat" type: "kConcate"
+              srclayers: "fc_a" srclayers: "fc_b"
+              concate_param { concate_dimension: 1 } }
+      layer { name: "slice" type: "kSlice" srclayers: "cat"
+              slice_param { slice_dimension: 1 slice_num: 2 } }
+      layer { name: "out_a" type: "kReLU" srclayers: "slice" }
+      layer { name: "out_b" type: "kReLU" srclayers: "slice" }
+      layer { name: "cat2" type: "kConcate"
+              srclayers: "out_a" srclayers: "out_b"
+              concate_param { concate_dimension: 1 } }
+      layer { name: "loss" type: "kSoftmaxLoss"
+              srclayers: "cat2" srclayers: "lab" }
+    }
+    """
+    cfg = model_config_from_text(text)
+    net = build_net(cfg, "kTrain", MNIST_SHAPES)
+    assert net.shapes["cat"] == (6, 16)
+    assert net.shapes["slice"] == ((6, 8), (6, 8))
+    assert net.shapes["cat2"] == (6, 16)
+    params = net.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(5)
+    loss, _, outputs = net.apply(params, _mnist_batch(6, rng))
+    np.testing.assert_allclose(
+        np.asarray(outputs["cat2"]),
+        np.maximum(np.asarray(outputs["cat"]), 0), rtol=1e-6)
+
+
+def test_uneven_slice_remainder_to_last():
+    """neuralnet.cc:160-162: remainder goes to the last partition."""
+    text = """
+    neuralnet {
+      layer { name: "data" type: "kShardData" data_param { batchsize: 2 } }
+      layer { name: "img" type: "kMnistImage" srclayers: "data" }
+      layer { name: "lab" type: "kLabel" srclayers: "data" }
+      layer { name: "fc" type: "kInnerProduct" srclayers: "img"
+              inner_product_param { num_output: 10 }
+              param { name: "weight" } param { name: "bias" } }
+      layer { name: "slice" type: "kSlice" srclayers: "fc"
+              slice_param { slice_dimension: 1 slice_num: 3 } }
+      layer { name: "a" type: "kReLU" srclayers: "slice" }
+      layer { name: "b" type: "kReLU" srclayers: "slice" }
+      layer { name: "c" type: "kReLU" srclayers: "slice" }
+      layer { name: "cat" type: "kConcate"
+              srclayers: "a" srclayers: "b" srclayers: "c"
+              concate_param { concate_dimension: 1 } }
+      layer { name: "loss" type: "kSoftmaxLoss"
+              srclayers: "cat" srclayers: "lab" }
+    }
+    """
+    cfg = model_config_from_text(text)
+    net = build_net(cfg, "kTrain", MNIST_SHAPES)
+    assert net.shapes["slice"] == ((2, 3), (2, 3), (2, 4))
+
+
+def test_debug_info_and_json():
+    cfg = load_model_config("/root/reference/examples/mnist/conv.conf")
+    net = build_net(cfg, "kTrain", MNIST_SHAPES, batchsize=2)
+    params = net.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(6)
+    _, _, outputs = net.apply(params, _mnist_batch(2, rng))
+    info = net.debug_info(params, outputs)
+    assert "conv1" in info and "param" in info
+    j = net.to_json()
+    assert '"nodes"' in j and '"links"' in j

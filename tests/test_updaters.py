@@ -1,0 +1,192 @@
+"""Updater/LR-schedule/init golden tests vs NumPy oracles implementing
+the reference math (updater.cc, param.cc:61-99)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from singa_tpu.config.schema import ParamConfig, UpdaterConfig
+from singa_tpu.core.init import init_param
+from singa_tpu.core.updater import Multipliers, Updater, learning_rate
+
+
+def _lr(method, step, **kw):
+    cfg = UpdaterConfig(type="kSGD", learning_rate_change_method=method, **kw)
+    return float(learning_rate(cfg, step))
+
+
+def test_lr_schedules_reference_formulas():
+    # kFixed
+    assert _lr("kFixed", 7, base_learning_rate=0.1) == pytest.approx(0.1)
+    # kLinear: (1-r)*base + r*final, r = step/freq
+    assert _lr("kLinear", 5, base_learning_rate=1.0, final_learning_rate=0.0,
+               learning_rate_change_frequency=10) == pytest.approx(0.5)
+    # kExponential: base / 2^(step/freq)
+    assert _lr("kExponential", 10, base_learning_rate=0.4,
+               final_learning_rate=0.2,
+               learning_rate_change_frequency=5) == pytest.approx(0.1)
+    # kInverse_t: base / (1 + step/final)
+    assert _lr("kInverse_t", 4, base_learning_rate=0.4,
+               final_learning_rate=0.2) == pytest.approx(0.4 / 21.0)
+    # kInverse: base * (1+gamma*step)^-pow    (conv.conf uses this)
+    assert _lr("kInverse", 100, base_learning_rate=0.01, gamma=0.0001,
+               pow=0.75) == pytest.approx(0.01 * (1.01) ** -0.75)
+    # kStep: base * gamma^(step // freq) — integer division (updater.cc:41-45)
+    assert _lr("kStep", 119, base_learning_rate=0.001, gamma=0.997,
+               learning_rate_change_frequency=60) == pytest.approx(
+                   0.001 * 0.997 ** 1)
+    assert _lr("kStep", 120, base_learning_rate=0.001, gamma=0.997,
+               learning_rate_change_frequency=60) == pytest.approx(
+                   0.001 * 0.997 ** 2)
+
+
+def _run_updater(utype, steps=3, **kw):
+    cfg = UpdaterConfig(type=utype, base_learning_rate=kw.pop("lr", 0.1), **kw)
+    up = Updater(cfg)
+    params = {"w": jnp.array([1.0, -2.0, 3.0])}
+    grads = {"w": jnp.array([0.5, 0.25, -1.0])}
+    state = up.init(params)
+    out = []
+    for step in range(steps):
+        params, state = up.update(step, grads, params, state)
+        out.append(np.asarray(params["w"]).copy())
+    return out, cfg
+
+
+def test_sgd_momentum_reference():
+    out, cfg = _run_updater("kSGD", momentum=0.9, weight_decay=0.01, lr=0.1)
+    p = np.array([1.0, -2.0, 3.0])
+    g0 = np.array([0.5, 0.25, -1.0])
+    h = np.zeros(3)
+    for step in range(3):
+        g = g0 + p * 0.01
+        h = h * 0.9 + 0.1 * g
+        p = p - h
+        np.testing.assert_allclose(out[step], p, rtol=1e-6)
+
+
+def test_nesterov_reference():
+    out, _ = _run_updater("kNesterov", momentum=0.9, lr=0.1)
+    p = np.array([1.0, -2.0, 3.0])
+    g = np.array([0.5, 0.25, -1.0])
+    h = np.zeros(3)
+    for step in range(3):
+        h_old = h.copy()
+        h = h * 0.9 + 0.1 * g
+        p = p - (h * 1.9 - h_old * 0.9)
+        np.testing.assert_allclose(out[step], p, rtol=1e-6)
+
+
+def test_adagrad_reference_wd_after_history():
+    """wd is folded into grad AFTER history accumulates the raw square
+    (updater.cc:121-127)."""
+    out, _ = _run_updater("kAdaGrad", weight_decay=0.1, lr=0.1)
+    p = np.array([1.0, -2.0, 3.0])
+    g0 = np.array([0.5, 0.25, -1.0])
+    h = np.zeros(3)
+    for step in range(3):
+        h = h + g0 ** 2
+        g = g0 + p * 0.1
+        p = p - 0.1 * g / np.sqrt(h + 1e-7)
+        np.testing.assert_allclose(out[step], p, rtol=1e-5)
+
+
+def test_rmsprop_reference():
+    out, _ = _run_updater("kRMSProp", rho=0.9, lr=0.1)
+    p = np.array([1.0, -2.0, 3.0])
+    g = np.array([0.5, 0.25, -1.0])
+    h = np.zeros(3)
+    for step in range(3):
+        h = h * 0.9 + 0.1 * g ** 2
+        p = p - 0.1 * g / np.sqrt(h + 1e-7)
+        np.testing.assert_allclose(out[step], p, rtol=1e-5)
+
+
+def test_adadelta_reference():
+    out, _ = _run_updater("kAdaDelta", rho=0.9, lr=0.0)
+    p = np.array([1.0, -2.0, 3.0])
+    g = np.array([0.5, 0.25, -1.0])
+    h = np.zeros(3)
+    u = np.zeros(3)
+    for step in range(3):
+        h = h * 0.9 + 0.1 * g ** 2
+        tmp = g * np.sqrt(u + 1e-7) / np.sqrt(h + 1e-7)
+        u = 0.9 * u + 0.1 * tmp ** 2
+        p = p - tmp
+        np.testing.assert_allclose(out[step], p, rtol=1e-5)
+
+
+def test_lr_multiplier_applied():
+    """conv.conf biases use learning_rate_multiplier: 2.0."""
+    cfg = UpdaterConfig(type="kSGD", base_learning_rate=0.1)
+    up = Updater(cfg)
+    params = {"w": jnp.array([1.0]), "b": jnp.array([1.0])}
+    grads = {"w": jnp.array([1.0]), "b": jnp.array([1.0])}
+    mults = {"w": Multipliers(1.0, 1.0), "b": Multipliers(2.0, 1.0)}
+    state = up.init(params)
+    params, _ = up.update(0, grads, params, state, multipliers=mults)
+    assert float(params["w"][0]) == pytest.approx(0.9)
+    assert float(params["b"][0]) == pytest.approx(0.8)
+
+
+def test_update_is_jittable():
+    cfg = UpdaterConfig(type="kRMSProp", base_learning_rate=0.1)
+    up = Updater(cfg)
+    params = {"w": jnp.ones((4, 4))}
+    state = up.init(params)
+
+    @jax.jit
+    def step_fn(step, params, state):
+        grads = {"w": jnp.ones((4, 4)) * 0.1}
+        return up.update(step, grads, params, state)
+
+    p1, s1 = step_fn(0, params, state)
+    p2, s2 = step_fn(1, p1, s1)
+    assert not np.allclose(np.asarray(p2["w"]), np.asarray(p1["w"]))
+
+
+# ---------------------------------------------------------------------------
+# init methods (param.cc:61-99)
+
+
+def test_init_constant():
+    x = init_param(jax.random.PRNGKey(0),
+                   ParamConfig(init_method="kConstant", value=0.25), (3, 2))
+    np.testing.assert_allclose(np.asarray(x), 0.25)
+
+
+def test_init_uniform_range_and_value_scale():
+    cfg = ParamConfig(init_method="kUniform", low=-0.05, high=0.05, value=2.0)
+    x = np.asarray(init_param(jax.random.PRNGKey(1), cfg, (2000,)))
+    assert x.min() >= -0.1 and x.max() <= 0.1
+    assert x.max() > 0.08  # scale actually applied
+
+
+def test_init_uniform_sqrt_fanin():
+    """kUniformSqrtFanIn: U(low,high) * value / sqrt(fan_in/3)
+    (param.cc:74-78); conv.conf uses defaults low=-1, high=1, value=1."""
+    cfg = ParamConfig(init_method="kUniformSqrtFanIn")
+    fan_in = 75  # e.g. conv1: 1*5*5*3
+    x = np.asarray(init_param(jax.random.PRNGKey(2), cfg, (500,), fan_in))
+    bound = 1.0 / math.sqrt(fan_in / 3.0)
+    assert abs(x).max() <= bound + 1e-6
+    assert abs(x).max() > bound * 0.98
+
+
+def test_init_uniform_sqrt_fanin_out():
+    cfg = ParamConfig(init_method="kUniformSqrtFanInOut", low=-1, high=1)
+    x = np.asarray(init_param(jax.random.PRNGKey(3), cfg, (30, 70)))
+    bound = 1.0 / math.sqrt(100)
+    assert abs(x).max() <= bound + 1e-6
+
+
+def test_init_gaussian_variants():
+    cfg = ParamConfig(init_method="kGaussain", mean=1.0, std=0.1)
+    x = np.asarray(init_param(jax.random.PRNGKey(4), cfg, (5000,)))
+    assert abs(x.mean() - 1.0) < 0.01
+    cfg2 = ParamConfig(init_method="kGaussainSqrtFanIn", std=1.0)
+    y = np.asarray(init_param(jax.random.PRNGKey(5), cfg2, (100, 50)))
+    assert abs(y.std() - 0.1) < 0.01  # scaled by 1/sqrt(shape[0]=100)
