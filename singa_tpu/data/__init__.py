@@ -1,0 +1,44 @@
+"""Data subsystem: Shard store, record codecs, loaders, prefetch."""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Iterator, Tuple
+
+from .records import Datum, Record, SingleLabelImageRecord
+from .shard import Shard, ShardError
+from .pipeline import Prefetcher, prefetch, shard_batches
+from .synthetic import synthetic_image_batches
+
+
+def resolve_data_source(model_cfg, batchsize: int, seed: int = 0,
+                        force_synthetic: bool = False
+                        ) -> Tuple[Iterator, Callable[[], Iterator]]:
+    """Pick (train_iter, test_iter_factory) for a model config: shard
+    folders from DataProto.path when they exist locally, else synthetic."""
+    train_path = test_path = None
+    train_name = test_name = "data"
+    for layer in (model_cfg.neuralnet.layer if model_cfg.neuralnet else []):
+        if layer.type in ("kShardData", "kLMDBData") and layer.data_param:
+            if "kTrain" not in layer.exclude:
+                train_path, train_name = layer.data_param.path, layer.name
+            else:
+                test_path, test_name = layer.data_param.path, layer.name
+
+    def shard_ok(p):
+        return (not force_synthetic and p and
+                os.path.isfile(os.path.join(p, "shard.dat")))
+
+    if shard_ok(train_path):
+        train_iter = prefetch(
+            shard_batches(train_path, batchsize, train_name, seed=seed))
+    else:
+        train_iter = synthetic_image_batches(
+            batchsize, data_layer=train_name, seed=seed)
+    if shard_ok(test_path):
+        test_factory = lambda: shard_batches(
+            test_path, batchsize, test_name, loop=False)
+    else:
+        test_factory = lambda: synthetic_image_batches(
+            batchsize, data_layer=test_name, seed=seed + 1)
+    return train_iter, test_factory

@@ -1,0 +1,85 @@
+"""Host input pipeline: shard reading + background prefetch.
+
+The reference overlaps I/O and compute with a per-executor prefetch
+thread and a double-buffered ParserLayer handoff (worker.cc:127-177,
+base_layer.h:510-560).  Here a background thread keeps a bounded queue
+of ready batches ahead of the device; normalization happens *on device*
+inside the jitted step, so host work is pure file I/O + batching.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from .records import Record
+from .shard import Shard
+
+
+def shard_batches(folder: str, batchsize: int, data_layer: str = "data",
+                  loop: bool = True, random_skip: int = 0,
+                  seed: int = 0) -> Iterator[Dict]:
+    """Batches from a shard folder of Record tuples, in file order
+    (ShardData semantics, layer.cc:646-673 incl. random_skip)."""
+    rng = np.random.default_rng(seed)
+    skip = rng.integers(0, random_skip + 1) if random_skip else 0
+    while True:
+        shard = Shard(folder, Shard.KREAD)
+        pixels, labels = [], []
+        for i, (_, val) in enumerate(shard):
+            if skip > 0:
+                skip -= 1
+                continue
+            rec = Record.decode(val)
+            if rec.image is None:
+                continue
+            pixels.append(rec.image.pixels_array())
+            labels.append(rec.image.label)
+            if len(pixels) == batchsize:
+                yield {data_layer: {
+                    "pixel": np.stack(pixels),
+                    "label": np.asarray(labels, np.int32)}}
+                pixels, labels = [], []
+        shard.close()
+        if not loop:
+            if pixels:  # final partial batch
+                yield {data_layer: {
+                    "pixel": np.stack(pixels),
+                    "label": np.asarray(labels, np.int32)}}
+            return
+
+
+class Prefetcher:
+    """Bounded background prefetch (the reference's prefetch thread,
+    worker.cc:163-177, generalized to a queue depth)."""
+
+    _END = object()
+
+    def __init__(self, it: Iterator, depth: int = 2):
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._it = it
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        try:
+            for item in self._it:
+                self._q.put(item)
+        finally:
+            self._q.put(self._END)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._END:
+            raise StopIteration
+        return item
+
+
+def prefetch(it: Iterator, depth: int = 2) -> Iterator:
+    return Prefetcher(it, depth)

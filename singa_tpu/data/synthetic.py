@@ -1,0 +1,42 @@
+"""Synthetic data sources (this environment has zero egress, so real
+dataset downloads are impossible; shard files can be built offline with
+singa_tpu.data.shard tools when data exists locally).
+
+Provides deterministic, learnable synthetic classification batches shaped
+like the reference's MNIST/CIFAR records so training loops and benchmarks
+exercise the identical compute path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+
+def synthetic_image_batches(
+        batchsize: int,
+        image_shape: Tuple[int, ...] = (28, 28),
+        nclass: int = 10,
+        data_layer: str = "data",
+        seed: int = 0,
+        learnable: bool = True,
+        dtype=np.uint8) -> Iterator[Dict]:
+    """Infinite iterator of {data_layer: {"pixel": u8, "label": i32}}.
+
+    When `learnable`, each class k has a fixed random template and samples
+    are noisy copies — so accuracy above chance proves learning end to end.
+    """
+    rng = np.random.default_rng(seed)
+    templates = rng.integers(0, 256, (nclass,) + tuple(image_shape))
+    while True:
+        labels = rng.integers(0, nclass, (batchsize,))
+        if learnable:
+            noise = rng.normal(0, 64, (batchsize,) + tuple(image_shape))
+            pixel = np.clip(templates[labels] + noise, 0, 255)
+        else:
+            pixel = rng.integers(0, 256, (batchsize,) + tuple(image_shape))
+        yield {data_layer: {
+            "pixel": pixel.astype(dtype),
+            "label": labels.astype(np.int32),
+        }}

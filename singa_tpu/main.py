@@ -1,0 +1,82 @@
+"""CLI entry point — the reference `singa` binary's flag surface.
+
+Reference: /root/reference/src/main.cc:13-18 — flags -procsID, -hostfile,
+-cluster_conf, -model_conf.  The reference forks Server/Worker
+personalities by process id (main.cc:49-55); on TPU there is no
+parameter-server personality (gradient aggregation is a compiled psum),
+so every process is a worker and -procsID/-hostfile map to
+jax.distributed process coordinates for multi-host runs.
+
+Usage:
+    python -m singa_tpu.main -model_conf examples/mnist/conv.conf \
+        -cluster_conf examples/mnist/cluster.conf [-procsID 0] [-hostfile h]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .config import load_cluster_config, load_model_config
+from .core.trainer import Trainer
+from .data.synthetic import synthetic_image_batches
+
+
+def make_argparser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="singa_tpu",
+        description="TPU-native SINGA-capability training runtime")
+    # single-dash long flags, gflags style (main.cc:13-18)
+    ap.add_argument("-model_conf", "--model_conf", required=True)
+    ap.add_argument("-cluster_conf", "--cluster_conf", default=None)
+    ap.add_argument("-procsID", "--procsID", type=int, default=0)
+    ap.add_argument("-hostfile", "--hostfile", default=None)
+    ap.add_argument("-v", type=int, default=0, help="verbosity (glog style)")
+    # TPU-native extras
+    ap.add_argument("--synthetic", action="store_true",
+                    help="use a synthetic learnable dataset (no egress env)")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="override ModelProto.train_steps")
+    ap.add_argument("--seed", type=int, default=0)
+    return ap
+
+
+def main(argv=None) -> int:
+    args = make_argparser().parse_args(argv)
+    model = load_model_config(args.model_conf)
+    cluster = (load_cluster_config(args.cluster_conf)
+               if args.cluster_conf else None)
+    if args.steps is not None:
+        model.train_steps = args.steps
+
+    # data-layer discovery: shapes for MNIST-style records
+    input_shapes = {}
+    for layer in (model.neuralnet.layer if model.neuralnet else []):
+        if layer.type in ("kShardData", "kLMDBData"):
+            input_shapes.setdefault(
+                layer.name, {"pixel": (28, 28), "label": ()})
+
+    trainer = Trainer(model, input_shapes)
+    params, opt_state = trainer.init(seed=args.seed)
+
+    train_layer = next(
+        (l for l in model.neuralnet.layer
+         if l.type in ("kShardData", "kLMDBData") and "kTrain" not in l.exclude),
+        None)
+    bs = train_layer.data_param.batchsize if train_layer else 64
+
+    # Data source: shard files if the configured path exists locally,
+    # else the synthetic source (reference configs point at dead hosts).
+    from .data import resolve_data_source
+    train_iter, test_factory = resolve_data_source(
+        model, bs, seed=args.seed, force_synthetic=args.synthetic)
+
+    params, opt_state, history = trainer.run(
+        params, opt_state, train_iter, test_iter_factory=test_factory,
+        seed=args.seed)
+    print("training done:", trainer.perf.to_string() or "(no metrics)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

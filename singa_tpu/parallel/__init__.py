@@ -1,0 +1,3 @@
+from .mesh import AXES, make_mesh, mesh_from_cluster
+from .partition import (param_shardings, batch_shardings, shard_params,
+                        shard_opt_state, shard_batch, replicated)

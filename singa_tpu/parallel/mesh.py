@@ -1,0 +1,89 @@
+"""Device mesh construction — the TPU-native Cluster topology.
+
+Reference: /root/reference/include/utils/cluster.h — process topology as
+(nworkers, nservers, nprocs_per_group, nthreads_per_procs) with worker
+groups running data-parallel replicas and intra-group executors running
+net partitions (§2.2 of SURVEY.md).  On TPU the topology is a
+jax.sharding.Mesh with named axes:
+
+  data    — data parallelism (reference: worker groups + kDataPartition)
+  model   — tensor parallelism (reference: kLayerPartition)
+  pipe    — pipeline stages (reference: locationid/bridge layers)
+  seq     — sequence/context parallelism (new; ring/Ulysses attention)
+  expert  — expert parallelism (new; MoE)
+
+Legacy ClusterProto fields map onto mesh axes via mesh_from_cluster();
+the server-plane fields (nservers, ports, bandwidth…) have no TPU
+meaning — gradient aggregation is a compiled psum — and are accepted
+and ignored with a note.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..config.schema import ClusterConfig
+
+AXES = ("data", "model", "pipe", "seq", "expert")
+
+
+def make_mesh(devices: Optional[Sequence] = None, *, data: int = 0,
+              model: int = 1, pipe: int = 1, seq: int = 1,
+              expert: int = 1) -> Mesh:
+    """Build a 5-axis mesh. `data=0` means "absorb remaining devices"."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    fixed = model * pipe * seq * expert
+    if data == 0:
+        if n % fixed:
+            raise ValueError(
+                f"{n} devices not divisible by model*pipe*seq*expert={fixed}")
+        data = n // fixed
+    total = data * fixed
+    if total != n:
+        raise ValueError(f"mesh {data}x{model}x{pipe}x{seq}x{expert}={total} "
+                         f"!= {n} devices")
+    arr = np.asarray(devices).reshape(data, model, pipe, seq, expert)
+    return Mesh(arr, AXES)
+
+
+def mesh_from_cluster(cluster: Optional[ClusterConfig],
+                      net_partition_type: str = "kNone",
+                      devices: Optional[Sequence] = None) -> Mesh:
+    """Map ClusterProto topology onto a mesh.
+
+    Explicit TPU-native axis fields win; otherwise the legacy fields are
+    interpreted per §2.2: ngroups = nworkers/nprocs_per_group groups of
+    group_size = nprocs_per_group*nthreads_per_procs executors each.
+    Groups are data-parallel; intra-group executors are data- or
+    model-parallel per NetProto.partition_type (cluster.h:49-60,
+    neuralnet.cc:45-56).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if cluster is None:
+        return make_mesh(devices)
+    if any((cluster.data_parallel, cluster.tensor_parallel,
+            cluster.pipeline_parallel, cluster.sequence_parallel,
+            cluster.expert_parallel)):
+        return make_mesh(
+            devices,
+            data=cluster.data_parallel or 0,
+            model=cluster.tensor_parallel or 1,
+            pipe=cluster.pipeline_parallel or 1,
+            seq=cluster.sequence_parallel or 1,
+            expert=cluster.expert_parallel or 1)
+    group_size = cluster.nprocs_per_group * cluster.nthreads_per_procs
+    ngroups = max(cluster.nworkers // max(cluster.nprocs_per_group, 1), 1)
+    if net_partition_type == "kLayerPartition" and group_size > 1:
+        tp = math.gcd(group_size, n)
+        return make_mesh(devices, model=tp)
+    # kDataPartition / kNone: all devices data-parallel
+    return make_mesh(devices)
