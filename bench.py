@@ -1,0 +1,71 @@
+"""Benchmark: MNIST LeNet (reference examples/mnist/conv.conf) training
+throughput on the available accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (README.md:1-5); BASELINE.md records
+its harness only.  `vs_baseline` is computed against REFERENCE_IMG_SEC,
+an estimate of the reference's single-node CPU throughput for the same
+conv.conf workload (batch 64, im2col+BLAS LeNet at ~1k img/s — the
+scale its 2015-era CPU cluster sweep targeted).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+REFERENCE_IMG_SEC = 1000.0
+BATCH = 512
+WARMUP = 3
+ITERS = 20
+
+
+def main() -> None:
+    import jax
+
+    from singa_tpu.config import load_model_config
+    from singa_tpu.core.trainer import Trainer
+
+    cfg = load_model_config("/root/reference/examples/mnist/conv.conf")
+    for layer in cfg.neuralnet.layer:
+        if layer.data_param:
+            layer.data_param.batchsize = BATCH
+    shapes = {"data": {"pixel": (28, 28), "label": ()}}
+    trainer = Trainer(cfg, shapes, log_fn=lambda s: None)
+    params, opt_state = trainer.init(seed=0)
+
+    rng = np.random.default_rng(0)
+    batch = {"data": {
+        "pixel": jax.device_put(
+            rng.integers(0, 256, (BATCH, 28, 28)).astype(np.uint8)),
+        "label": jax.device_put(
+            rng.integers(0, 10, (BATCH,)).astype(np.int32)),
+    }}
+    key = jax.random.PRNGKey(0)
+
+    for step in range(WARMUP):
+        params, opt_state, metrics = trainer.train_step(
+            params, opt_state, batch, step, key)
+    jax.block_until_ready(params)
+
+    t0 = time.perf_counter()
+    for step in range(WARMUP, WARMUP + ITERS):
+        params, opt_state, metrics = trainer.train_step(
+            params, opt_state, batch, step, key)
+    jax.block_until_ready(params)
+    dt = time.perf_counter() - t0
+
+    img_sec = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "mnist_lenet_train_throughput",
+        "value": round(img_sec, 1),
+        "unit": "img/sec/chip",
+        "vs_baseline": round(img_sec / REFERENCE_IMG_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()
