@@ -239,6 +239,20 @@ class RBMConfig:
     persistent: bool = False
 
 
+@dataclass
+class FFNConfig:
+    hidden_dim: int = 0
+    activation: str = "silu"     # silu | gelu | relu
+    gated: bool = True           # SwiGLU-style gating
+
+
+@dataclass
+class SequenceDataConfig:
+    batchsize: int = 0
+    seq_len: int = 0
+    vocab_size: int = 0
+
+
 # ---------------------------------------------------------------------------
 # ParamProto (model.proto:54-106)
 
@@ -301,6 +315,8 @@ class LayerConfig:
     embed_param: Optional[EmbedConfig] = _msg(EmbedConfig)
     rmsnorm_param: Optional[RMSNormConfig] = _msg(RMSNormConfig)
     rbm_param: Optional[RBMConfig] = _msg(RBMConfig)
+    ffn_param: Optional[FFNConfig] = _msg(FFNConfig)
+    seqdata_param: Optional[SequenceDataConfig] = _msg(SequenceDataConfig)
 
     def __post_init__(self):
         for ph in self.exclude:

@@ -44,6 +44,9 @@ class ParamSpec:
     cfg: ParamConfig
     # sharding hint: ParamProto.partition_dim (-1 = replicate)
     partition_dim: int = -1
+    # mesh axis the partition_dim shards over; None = the TP axis
+    # ("model"). MoE expert-stacked params use "expert".
+    mesh_axis: Optional[str] = None
 
 
 @dataclass
@@ -53,6 +56,8 @@ class Context:
     train: bool
     rng: Optional[jax.Array] = None
     layer_index: int = 0
+    mesh: Any = None            # jax.sharding.Mesh for SP/EP-aware layers
+    compute_dtype: Any = None   # e.g. jnp.bfloat16 under ModelProto.precision
 
     def layer_rng(self) -> jax.Array:
         if self.rng is None:
@@ -393,8 +398,12 @@ class SoftmaxLossLayer(Layer):
 
     def apply(self, params, srcs, ctx):
         logits, labels = srcs
-        loss, prec = ops.softmax_loss_metrics(logits, labels, self.topk,
-                                              self.scale)
+        if labels.ndim > 1:
+            # sequence labels (B, S): flatten to (B*S, V) token-level NLL
+            logits = logits.reshape(-1, logits.shape[-1])
+            labels = labels.reshape(-1)
+        loss, prec = ops.softmax_loss_metrics(
+            logits.astype(jnp.float32), labels, self.topk, self.scale)
         return {"loss": loss, "precision": prec}
 
 

@@ -136,7 +136,8 @@ class NeuralNet:
 
     # -- forward -----------------------------------------------------------
     def apply(self, params: Dict[str, jnp.ndarray], batch: Dict[str, Any],
-              rng: Optional[jax.Array] = None, train: Optional[bool] = None
+              rng: Optional[jax.Array] = None, train: Optional[bool] = None,
+              mesh=None, compute_dtype=None
               ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray], Dict[str, Any]]:
         """Run the net. Returns (total_loss, metrics, outputs).
 
@@ -156,9 +157,16 @@ class NeuralNet:
             srcs = [self._src_out(outputs, src, name)
                     for src in layer.cfg.srclayers]
             ctx = Context(batch=ctx_batch, train=train, rng=rng,
-                          layer_index=idx)
+                          layer_index=idx, mesh=mesh,
+                          compute_dtype=compute_dtype)
             out = layer.apply(full, srcs, ctx)
             outputs[name] = out
+            aux = getattr(layer, "_aux", None)
+            if aux is not None:
+                # auxiliary losses (e.g. MoE router balance) join the
+                # objective and the metric report
+                total_loss = total_loss + aux
+                metrics[f"{name}/aux"] = aux
             if layer.is_loss:
                 total_loss = total_loss + out["loss"]
                 for k, v in out.items():

@@ -1,0 +1,326 @@
+"""Sequence-model layer family: the transformer extension of the layer
+zoo, declared in the same NetProto-style config IR as the conv layers.
+
+New capability (SURVEY.md §5: the reference predates attention) exposed
+"the same way the reference exposes partitioning, i.e. as declarative
+config": attention_param.seq_parallel selects none/ring/ulysses; expert
+parallelism comes from MoE expert-stacked params sharded over the
+mesh's "expert" axis; tensor parallelism from partition_dim on the
+projection weights.
+
+Layer types: kSequenceData, kEmbed, kRMSNorm, kAttention, kFeedForward,
+kMoE, kLMHead.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..config.schema import ParamConfig
+from ..ops import moe as moe_ops
+from ..ops.attention import (attention_reference, expand_kv_heads,
+                             flash_attention, rope)
+from .layers import Layer, LayerError, register_layer
+
+
+def _gaussian(std: float) -> ParamConfig:
+    return ParamConfig(init_method="kGaussain", mean=0.0, std=std)
+
+
+def _declare_with_default(layer: Layer, i: int, name: str, shape,
+                          init_std: float, partition_dim: int = -1,
+                          mesh_axis: Optional[str] = None) -> str:
+    """Declare a param with a Gaussian default when the config gives no
+    explicit ParamProto (transformer configs usually don't)."""
+    from .layers import ParamSpec
+    if i < len(layer.cfg.param):
+        key = layer._declare(i, name, shape, fan_in=shape[0],
+                             partition_dim=partition_dim)
+        layer.param_specs[-1].mesh_axis = mesh_axis
+        return key
+    pcfg = _gaussian(init_std)
+    key = f"{layer.name}/{name}"
+    layer.param_specs.append(
+        ParamSpec(key, tuple(shape), shape[0], pcfg, partition_dim,
+                  mesh_axis))
+    return key
+
+
+@register_layer("kSequenceData")
+class SequenceDataLayer(Layer):
+    """Token-sequence input: ctx.batch[name] = {"input": (B,S) int32,
+    "target": (B,S) int32}."""
+
+    is_data = True
+
+    def setup(self, src_shapes, sample_shapes: Optional[Dict] = None):
+        p = self.cfg.seqdata_param
+        bs = p.batchsize if p else (self.cfg.data_param.batchsize
+                                    if self.cfg.data_param else 0)
+        seq = p.seq_len if p else 0
+        self.batchsize, self.seq_len = bs, seq
+        self.vocab_size = p.vocab_size if p else 0
+        if sample_shapes:
+            self.out_shape = {k: (bs,) + tuple(v)
+                              for k, v in sample_shapes.items()}
+        else:
+            self.out_shape = {"input": (bs, seq), "target": (bs, seq)}
+
+    def apply(self, params, srcs, ctx):
+        return ctx.batch[self.name]
+
+
+@register_layer("kEmbed")
+class EmbedLayer(Layer):
+    """Token embedding: (B, S) int32 → (B, S, E)."""
+
+    def setup(self, src_shapes):
+        p = self.cfg.embed_param
+        if p is None or not p.vocab_size or not p.embed_dim:
+            raise LayerError(f"{self.name}: embed_param vocab_size/embed_dim "
+                             "required")
+        src = src_shapes[0]
+        shape = src["input"] if isinstance(src, dict) else tuple(src)
+        self.out_shape = tuple(shape) + (p.embed_dim,)
+        self.w_key = _declare_with_default(
+            self, 0, "embedding", (p.vocab_size, p.embed_dim),
+            init_std=1.0 / math.sqrt(p.embed_dim), partition_dim=1)
+
+    def apply(self, params, srcs, ctx):
+        src = srcs[0]
+        tokens = src["input"] if isinstance(src, dict) else src
+        emb = params[self.w_key]
+        if ctx.compute_dtype is not None:
+            emb = emb.astype(ctx.compute_dtype)
+        return jnp.take(emb, tokens.astype(jnp.int32), axis=0)
+
+
+@register_layer("kSeqLabel")
+class SeqLabelLayer(Layer):
+    """Next-token targets from the sequence data dict."""
+
+    def setup(self, src_shapes):
+        self.out_shape = tuple(src_shapes[0]["target"])
+
+    def apply(self, params, srcs, ctx):
+        return srcs[0]["target"]
+
+
+@register_layer("kRMSNorm")
+class RMSNormLayer(Layer):
+    def setup(self, src_shapes):
+        p = self.cfg.rmsnorm_param
+        self.eps = p.epsilon if p else 1e-6
+        s = tuple(src_shapes[0])
+        self.out_shape = s
+        key = f"{self.name}/scale"
+        from .layers import ParamSpec
+        self.param_specs.append(ParamSpec(
+            key, (s[-1],), 0, ParamConfig(init_method="kConstant", value=1.0)))
+        self.w_key = key
+
+    def apply(self, params, srcs, ctx):
+        x = srcs[0]
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                       keepdims=True)
+        y = x * jax.lax.rsqrt(var + self.eps).astype(x.dtype)
+        return y * params[self.w_key].astype(x.dtype)
+
+
+@register_layer("kAttention")
+class AttentionLayer(Layer):
+    """Multi-head (GQA) causal self-attention with RoPE.
+
+    seq_parallel: "none" → Pallas flash attention on the local chunk;
+    "ring" / "ulysses" → sequence-parallel attention over the mesh's
+    "seq" axis (singa_tpu.parallel.sequence).
+    """
+
+    def setup(self, src_shapes):
+        p = self.cfg.attention_param
+        if p is None:
+            raise LayerError(f"{self.name}: attention_param required")
+        b, s, e = tuple(src_shapes[0])
+        self.heads = p.num_heads
+        self.kv_heads = p.num_kv_heads or p.num_heads
+        self.head_dim = p.head_dim
+        self.causal = p.causal
+        self.seq_parallel = p.seq_parallel
+        self.use_rope = p.rope
+        self.rope_theta = p.rope_theta
+        self.out_shape = (b, s, e)
+        hd = self.heads * self.head_dim
+        kvd = self.kv_heads * self.head_dim
+        std = 1.0 / math.sqrt(e)
+        self.wq = _declare_with_default(self, 0, "wq", (e, hd), std, 1)
+        self.wk = _declare_with_default(self, 1, "wk", (e, kvd), std, 1)
+        self.wv = _declare_with_default(self, 2, "wv", (e, kvd), std, 1)
+        self.wo = _declare_with_default(self, 3, "wo", (hd, e), std, 0)
+
+    def _proj(self, params, key, x, ctx):
+        w = params[key]
+        if ctx.compute_dtype is not None:
+            w = w.astype(ctx.compute_dtype)
+        return jnp.einsum("bse,ed->bsd", x, w,
+                          preferred_element_type=jnp.float32).astype(x.dtype)
+
+    def apply(self, params, srcs, ctx):
+        x = srcs[0]
+        b, s, e = x.shape
+        q = self._proj(params, self.wq, x, ctx).reshape(
+            b, s, self.heads, self.head_dim).transpose(0, 2, 1, 3)
+        k = self._proj(params, self.wk, x, ctx).reshape(
+            b, s, self.kv_heads, self.head_dim).transpose(0, 2, 1, 3)
+        v = self._proj(params, self.wv, x, ctx).reshape(
+            b, s, self.kv_heads, self.head_dim).transpose(0, 2, 1, 3)
+        if self.use_rope:
+            pos = jnp.arange(s)
+            q = rope(q, pos, self.rope_theta)
+            k = rope(k, pos, self.rope_theta)
+        k = expand_kv_heads(k, self.heads)
+        v = expand_kv_heads(v, self.heads)
+
+        if self.seq_parallel == "ring" and ctx.mesh is not None:
+            from ..parallel.sequence import ring_attention
+            out = ring_attention(q, k, v, ctx.mesh, "seq", self.causal)
+        elif self.seq_parallel == "ulysses" and ctx.mesh is not None:
+            from ..parallel.sequence import ulysses_attention
+            out = ulysses_attention(q, k, v, ctx.mesh, "seq", self.causal)
+        elif s % 128 == 0 and self.head_dim % 8 == 0:
+            out = flash_attention(q, k, v, self.causal)
+        else:
+            out = attention_reference(q, k, v, self.causal)
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, -1)
+        return self._proj(params, self.wo, out.astype(x.dtype), ctx)
+
+
+@register_layer("kFeedForward")
+class FeedForwardLayer(Layer):
+    """Gated (SwiGLU) or plain MLP over (B, S, E)."""
+
+    def setup(self, src_shapes):
+        p = self.cfg.ffn_param
+        if p is None or not p.hidden_dim:
+            raise LayerError(f"{self.name}: ffn_param.hidden_dim required")
+        b, s, e = tuple(src_shapes[0])
+        f = p.hidden_dim
+        if p.activation not in ("silu", "gelu", "relu"):
+            raise LayerError(f"{self.name}: unknown ffn activation "
+                             f"{p.activation!r} (silu|gelu|relu)")
+        self.activation = p.activation
+        self.gated = p.gated
+        self.out_shape = (b, s, e)
+        std = 1.0 / math.sqrt(e)
+        self.w1 = _declare_with_default(self, 0, "w1", (e, f), std, 1)
+        self.w2 = _declare_with_default(self, 1, "w2", (f, e),
+                                        1.0 / math.sqrt(f), 0)
+        if self.gated:
+            self.w3 = _declare_with_default(self, 2, "w3", (e, f), std, 1)
+
+    def apply(self, params, srcs, ctx):
+        x = srcs[0]
+
+        def cast(w):
+            return (w.astype(ctx.compute_dtype)
+                    if ctx.compute_dtype is not None else w)
+        act = {"silu": jax.nn.silu, "gelu": jax.nn.gelu,
+               "relu": jax.nn.relu}[self.activation]
+        h = jnp.einsum("bse,ef->bsf", x, cast(params[self.w1]),
+                       preferred_element_type=jnp.float32).astype(x.dtype)
+        h = act(h)
+        if self.gated:
+            g = jnp.einsum("bse,ef->bsf", x, cast(params[self.w3]),
+                           preferred_element_type=jnp.float32).astype(x.dtype)
+            h = h * g
+        return jnp.einsum("bsf,fe->bse", h, cast(params[self.w2]),
+                          preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+@register_layer("kMoE")
+class MoELayer(Layer):
+    """Mixture-of-experts FFN; expert-stacked weights shard over the
+    "expert" mesh axis (partition_dim=0 on the stacked leading dim)."""
+
+    is_loss = False
+
+    def setup(self, src_shapes):
+        p = self.cfg.moe_param
+        if p is None:
+            raise LayerError(f"{self.name}: moe_param required")
+        b, s, e = tuple(src_shapes[0])
+        self.n_exp = p.num_experts
+        self.k = p.experts_per_token
+        self.capacity_factor = p.capacity_factor
+        self.aux_coef = p.router_aux_coef
+        f = p.expert_hidden or 4 * e
+        self.out_shape = (b, s, e)
+        std = 1.0 / math.sqrt(e)
+        self.router = _declare_with_default(self, 0, "router",
+                                            (e, self.n_exp), std)
+        self.w1 = _declare_with_default(self, 1, "w1",
+                                        (self.n_exp, e, f), std, 0,
+                                        mesh_axis="expert")
+        self.b1 = _declare_with_default(self, 2, "b1", (self.n_exp, f),
+                                        0.0, 0, mesh_axis="expert")
+        self.w2 = _declare_with_default(self, 3, "w2",
+                                        (self.n_exp, f, e),
+                                        1.0 / math.sqrt(f), 0,
+                                        mesh_axis="expert")
+        self.b2 = _declare_with_default(self, 4, "b2", (self.n_exp, e),
+                                        0.0, 0, mesh_axis="expert")
+        self._aux = None
+
+    def apply(self, params, srcs, ctx):
+        x = srcs[0]
+        p = {"router": params[self.router], "w1": params[self.w1],
+             "b1": params[self.b1], "w2": params[self.w2],
+             "b2": params[self.b2]}
+        if ctx.compute_dtype is not None:
+            p = {k: v.astype(ctx.compute_dtype) for k, v in p.items()}
+        out, aux = moe_ops.moe_ffn(x, p, self.k, self.capacity_factor)
+        # expose the router aux loss through a side metric dict entry
+        self._aux = self.aux_coef * aux
+        return out
+
+
+@register_layer("kResidualAdd")
+class ResidualAddLayer(Layer):
+    """out = srcs[0] + srcs[1] — explicit residual edges in the DAG."""
+
+    def setup(self, src_shapes):
+        self.out_shape = tuple(src_shapes[0])
+
+    def apply(self, params, srcs, ctx):
+        return srcs[0] + srcs[1]
+
+
+@register_layer("kLMHead")
+class LMHeadLayer(Layer):
+    """(B, S, E) → (B, S, V) logits; optionally tied to the embedding via
+    share_param."""
+
+    def setup(self, src_shapes):
+        p = self.cfg.embed_param
+        if p is None or not p.vocab_size:
+            raise LayerError(f"{self.name}: embed_param.vocab_size required")
+        b, s, e = tuple(src_shapes[0])
+        self.out_shape = (b, s, p.vocab_size)
+        # tied head: share_param aliases the (vocab, E) embedding, which
+        # must be transposed at use — decided here from the config, not
+        # from a shape heuristic (vocab == E would be ambiguous)
+        self.tied = bool(self.cfg.share_param)
+        self.w_key = _declare_with_default(
+            self, 0, "w", (e, p.vocab_size), 1.0 / math.sqrt(e), 1)
+
+    def apply(self, params, srcs, ctx):
+        w = params[self.w_key]
+        if self.tied:
+            w = w.T
+        if ctx.compute_dtype is not None:
+            w = w.astype(ctx.compute_dtype)
+        return jnp.einsum("bse,ev->bsv", srcs[0], w,
+                          preferred_element_type=jnp.float32)

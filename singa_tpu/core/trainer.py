@@ -85,9 +85,12 @@ class Trainer:
     def __init__(self, model_cfg: ModelConfig,
                  input_shapes: Dict[str, Dict[str, tuple]],
                  log_fn: Callable[[str], None] = print,
-                 donate: bool = True):
+                 donate: bool = True, mesh=None):
         self.cfg = model_cfg
         self.log = log_fn
+        self.mesh = mesh
+        self.compute_dtype = (jnp.bfloat16
+                              if model_cfg.precision == "bfloat16" else None)
         self.train_net = build_net(model_cfg, "kTrain", input_shapes)
         self.test_net = self._maybe_net("kTest", input_shapes)
         self.val_net = self._maybe_net("kValidation", input_shapes)
@@ -107,10 +110,12 @@ class Trainer:
     # -- compiled steps ----------------------------------------------------
     def _build_steps(self, donate: bool) -> None:
         net, updater, mults = self.train_net, self.updater, self.multipliers
+        mesh, cdtype = self.mesh, self.compute_dtype
 
         def train_step(params, opt_state, batch, step, rng):
             def loss_fn(p):
-                loss, metrics, _ = net.apply(p, batch, rng=rng, train=True)
+                loss, metrics, _ = net.apply(p, batch, rng=rng, train=True,
+                                             mesh=mesh, compute_dtype=cdtype)
                 return loss, metrics
             (loss, metrics), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
@@ -123,7 +128,8 @@ class Trainer:
 
         def make_eval(net):
             def eval_step(params, batch):
-                _, metrics, _ = net.apply(params, batch, train=False)
+                _, metrics, _ = net.apply(params, batch, train=False,
+                                          mesh=mesh, compute_dtype=cdtype)
                 return metrics
             return jax.jit(eval_step)
 
@@ -167,8 +173,16 @@ class Trainer:
             test_iter_factory: Optional[Callable[[], Iterator]] = None,
             val_iter_factory: Optional[Callable[[], Iterator]] = None,
             start_step: int = 0, seed: int = 0,
-            hooks: Optional[List[Callable[[int, Dict], None]]] = None):
-        """The Worker::Run loop (worker.cc:98-106)."""
+            hooks: Optional[List[Callable[[int, Dict], None]]] = None,
+            workspace: Optional[str] = None):
+        """The Worker::Run loop (worker.cc:98-106).  With `workspace`,
+        checkpoints {params, opt_state, step} at checkpoint_frequency and
+        on completion (the resume path the reference left as a TODO,
+        worker.cc:65-67)."""
+        ckpt = None
+        if workspace and self.cfg.checkpoint_frequency > 0:
+            from ..utils.checkpoint import CheckpointManager
+            ckpt = CheckpointManager(workspace)
         rng = jax.random.PRNGKey(seed ^ 0x5eed)
         history: List[Dict[str, float]] = []
         for step in range(start_step, self.cfg.train_steps):
@@ -203,4 +217,20 @@ class Trainer:
                 self.log(f"step-{step}: {self.perf.to_string()}")
                 self.log(self.timer.to_string())
                 self.perf.reset()
+            if (ckpt is not None and self.cfg.checkpoint_frequency > 0
+                    and step >= self.cfg.checkpoint_after_steps
+                    and (step + 1) % self.cfg.checkpoint_frequency == 0):
+                ckpt.save(step + 1, params, opt_state)
+        if ckpt is not None and self.cfg.train_steps > start_step:
+            ckpt.save(self.cfg.train_steps, params, opt_state)
         return params, opt_state, history
+
+    def resume(self, params, opt_state, workspace: str):
+        """Restore the latest snapshot (Worker::Resume, finally real).
+        Returns (params, opt_state, start_step)."""
+        from ..utils.checkpoint import CheckpointManager
+        restored = CheckpointManager(workspace).restore(
+            template={"params": params, "opt_state": opt_state})
+        if restored is None:
+            return params, opt_state, 0
+        return restored

@@ -1,0 +1,125 @@
+"""Decoder-only transformer LM — the modern flagship model family.
+
+Declared in the same NetProto-style config IR as the reference's conv
+nets (SURVEY.md §5: expose SP/CP "the same way the reference exposes
+partitioning — as declarative config").  Pre-norm blocks:
+
+    x += attn(rmsnorm(x));  x += ffn_or_moe(rmsnorm(x))
+
+`seq_parallel` threads attention through ring/Ulysses over the mesh's
+"seq" axis; `moe_every > 0` replaces every Nth FFN with a top-k MoE
+whose experts shard over "expert"; projection weights carry
+partition_dim for tensor parallelism over "model".
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..config.schema import ModelConfig, model_config_from_dict
+from ..core import seq_layers  # noqa: F401  (registers the layer types)
+
+
+def transformer_lm(vocab_size: int = 32000,
+                   num_layers: int = 4,
+                   embed_dim: int = 512,
+                   num_heads: int = 8,
+                   head_dim: int = 64,
+                   num_kv_heads: int = 0,
+                   ffn_hidden: int = 0,
+                   seq_len: int = 1024,
+                   batchsize: int = 8,
+                   seq_parallel: str = "none",
+                   moe_every: int = 0,
+                   num_experts: int = 8,
+                   experts_per_token: int = 2,
+                   train_steps: int = 1000,
+                   learning_rate: float = 3e-4,
+                   precision: str = "float32",
+                   tie_embeddings: bool = True) -> ModelConfig:
+    ffn_hidden = ffn_hidden or int(embed_dim * 8 / 3 // 64 * 64) or 256
+    layers: List[Dict] = [
+        {"name": "data", "type": "kSequenceData",
+         "seqdata_param": {"batchsize": batchsize, "seq_len": seq_len,
+                           "vocab_size": vocab_size}},
+        {"name": "labels", "type": "kSeqLabel", "srclayers": "data"},
+        {"name": "embed", "type": "kEmbed", "srclayers": "data",
+         "embed_param": {"vocab_size": vocab_size, "embed_dim": embed_dim}},
+    ]
+    src = "embed"
+    for i in range(num_layers):
+        attn_in = f"ln{i}a"
+        layers.append({"name": attn_in, "type": "kRMSNorm",
+                       "srclayers": src})
+        layers.append({
+            "name": f"attn{i}", "type": "kAttention", "srclayers": attn_in,
+            "attention_param": {
+                "num_heads": num_heads, "head_dim": head_dim,
+                "causal": True, "seq_parallel": seq_parallel,
+                "num_kv_heads": num_kv_heads}})
+        layers.append({"name": f"res{i}a", "type": "kResidualAdd",
+                       "srclayers": [src, f"attn{i}"]})
+        ffn_in = f"ln{i}b"
+        layers.append({"name": ffn_in, "type": "kRMSNorm",
+                       "srclayers": f"res{i}a"})
+        use_moe = moe_every > 0 and (i + 1) % moe_every == 0
+        if use_moe:
+            layers.append({
+                "name": f"moe{i}", "type": "kMoE", "srclayers": ffn_in,
+                "moe_param": {"num_experts": num_experts,
+                              "experts_per_token": experts_per_token,
+                              "expert_hidden": ffn_hidden}})
+            block_out = f"moe{i}"
+        else:
+            layers.append({
+                "name": f"ffn{i}", "type": "kFeedForward",
+                "srclayers": ffn_in,
+                "ffn_param": {"hidden_dim": ffn_hidden}})
+            block_out = f"ffn{i}"
+        layers.append({"name": f"res{i}b", "type": "kResidualAdd",
+                       "srclayers": [f"res{i}a", block_out]})
+        src = f"res{i}b"
+
+    layers.append({"name": "ln_f", "type": "kRMSNorm", "srclayers": src})
+    head = {"name": "lm_head", "type": "kLMHead", "srclayers": "ln_f",
+            "embed_param": {"vocab_size": vocab_size,
+                            "embed_dim": embed_dim}}
+    if tie_embeddings:
+        head["share_param"] = ["embed/embedding"]
+        head["param"] = [{"name": "w"}]
+    layers.append(head)
+    layers.append({"name": "loss", "type": "kSoftmaxLoss",
+                   "srclayers": ["lm_head", "labels"],
+                   "softmaxloss_param": {"topk": 1}})
+
+    return model_config_from_dict({
+        "name": f"transformer-lm-{num_layers}L{embed_dim}E",
+        "train_steps": train_steps,
+        "display_frequency": 50,
+        "precision": precision,
+        "updater": {"type": "kAdam", "base_learning_rate": learning_rate,
+                    "weight_decay": 0.0,
+                    "learning_rate_change_method": "kFixed"},
+        "neuralnet": {"layer": layers},
+    })
+
+
+def synthetic_token_batches(batchsize: int, seq_len: int, vocab_size: int,
+                            seed: int = 0, data_layer: str = "data"):
+    """Learnable synthetic LM data: order-2 Markov chains with a fixed
+    random transition table — a model that learns beats the unigram
+    entropy floor."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    # sparse-ish transition: each (prev) maps to 4 likely next tokens
+    nexts = rng.integers(0, vocab_size, (vocab_size, 4))
+    while True:
+        toks = np.empty((batchsize, seq_len + 1), np.int32)
+        toks[:, 0] = rng.integers(0, vocab_size, batchsize)
+        choices = rng.integers(0, 4, (batchsize, seq_len))
+        noise = rng.random((batchsize, seq_len)) < 0.1
+        rand_tok = rng.integers(0, vocab_size, (batchsize, seq_len))
+        for t in range(seq_len):
+            nxt = nexts[toks[:, t], choices[:, t]]
+            toks[:, t + 1] = np.where(noise[:, t], rand_tok[:, t], nxt)
+        yield {data_layer: {"input": toks[:, :-1], "target": toks[:, 1:]}}

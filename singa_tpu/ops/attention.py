@@ -1,0 +1,253 @@
+"""Attention ops: Pallas flash attention + pure-jnp reference.
+
+New capability (the reference predates attention; SURVEY.md §5
+"long-context"): blockwise attention with online softmax so the S×S
+score matrix never materializes in HBM — the TPU memory-hierarchy-aware
+formulation (HBM→VMEM streaming, MXU matmuls per tile).
+
+`flash_attention` runs the Pallas kernel on TPU and falls back to the
+jnp reference elsewhere (the kernel is also unit-tested in interpreter
+mode).  The backward pass recomputes attention blockwise via the
+reference formulation under jax.checkpoint semantics — standard
+FlashAttention-style rematerialization.
+
+Also here: rotary position embeddings (RoPE) and GQA head expansion
+used by the transformer model family.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# reference attention (oracle + backward path)
+
+
+def attention_reference(q, k, v, causal: bool = True,
+                        q_offset: int = 0, kv_offset: int = 0):
+    """q: (B, H, Sq, D), k/v: (B, H, Sk, D). Offsets give the absolute
+    positions of the local q/kv chunks (used by ring attention)."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / math.sqrt(d)
+    if causal:
+        qpos = jnp.arange(q.shape[2]) + q_offset
+        kpos = jnp.arange(k.shape[2]) + kv_offset
+        mask = qpos[:, None] >= kpos[None, :]
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash kernel
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+                  *, causal: bool, scale: float, block_q: int,
+                  block_k: int):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # causal: skip fully-masked kv blocks (block start beyond q block end)
+    def compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            qpos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    if causal:
+        @pl.when(ik * block_k <= (iq + 1) * block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
+                    ).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
+                   interpret: bool):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq, bk = min(block_q, sq), min(block_k, sk)
+    assert sq % bq == 0 and sk % bk == 0, (
+        f"seq lens ({sq},{sk}) must be multiples of blocks ({bq},{bk})")
+    scale = 1.0 / math.sqrt(d)
+    qr = q.reshape(b * h, sq, d)
+    kr = k.reshape(b * h, sk, d)
+    vr = v.reshape(b * h, sk, d)
+    grid = (b * h, sq // bq, sk // bk)
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, causal=causal, scale=scale,
+                          block_q=bq, block_k=bk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, iq, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, iq, ik: (bh, ik, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, iq, ik: (bh, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, sq, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
+                    block_k: int = 128, interpret: Optional[bool] = None):
+    """FlashAttention. q/k/v: (B, H, S, D).  On non-TPU backends (or with
+    interpret=True) the Pallas kernel runs interpreted; backward is
+    blockwise rematerialization."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def chunk_attention(q, k, v, causal: bool, q_off, kv_off):
+    """Partial attention of a Q chunk vs a KV chunk with absolute-position
+    causal masking.  Returns (normalized out, lse) — the mergeable form
+    shared by the blockwise backward here and ring attention
+    (singa_tpu.parallel.sequence)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) / math.sqrt(d)
+    if causal:
+        qpos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2) + q_off
+        kpos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 3) + kv_off
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m_safe = jnp.maximum(m, NEG_INF / 2)   # guard fully-masked rows
+    p = jnp.exp(s - m_safe)
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    out = out / jnp.maximum(l, 1e-30)
+    lse = jnp.where(l > 0, m_safe + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF)
+    return out, lse
+
+
+def merge_attention(out1, lse1, out2, lse2):
+    """Merge two partial (normalized, lse) attention results."""
+    lse = jnp.logaddexp(jnp.maximum(lse1, NEG_INF),
+                        jnp.maximum(lse2, NEG_INF))
+    return out1 * jnp.exp(lse1 - lse) + out2 * jnp.exp(lse2 - lse), lse
+
+
+def blockwise_attention(q, k, v, causal: bool = True, block_k: int = 512):
+    """O(S·block_k)-memory attention: lax.scan over KV chunks with
+    jax.checkpoint per chunk, merging partials in log-sum-exp space.
+    Autodiff through this gives the FlashAttention-style backward —
+    chunks are rematerialized, never the full (S,S) score matrix."""
+    b, h, sk, d = k.shape
+    bk = min(block_k, sk)
+    if sk % bk:
+        return attention_reference(q, k, v, causal)
+    nkv = sk // bk
+
+    kb = k.reshape(b, h, nkv, bk, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(b, h, nkv, bk, d).transpose(2, 0, 1, 3, 4)
+
+    @jax.checkpoint
+    def chunk(q, kc, vc, kv_off):
+        return chunk_attention(q, kc, vc, causal, 0, kv_off)
+
+    def step(carry, blk):
+        out, lse = carry
+        kc, vc, i = blk
+        o_new, lse_new = chunk(q, kc, vc, i * bk)
+        return merge_attention(out, lse, o_new, lse_new), None
+
+    out0 = jnp.zeros(q.shape, jnp.float32)
+    lse0 = jnp.full(q.shape[:3] + (1,), NEG_INF, jnp.float32)
+    (out, _), _ = jax.lax.scan(step, (out0, lse0),
+                               (kb, vb, jnp.arange(nkv)))
+    return out.astype(q.dtype)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    # Blockwise recompute: grads come from the O(S·block)-memory
+    # formulation — the full (S,S) score matrix is never materialized,
+    # matching the flash forward's memory profile.
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal),
+        q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# RoPE + GQA helpers
+
+
+def rope(x: jnp.ndarray, positions: jnp.ndarray,
+         theta: float = 10000.0) -> jnp.ndarray:
+    """Rotary embeddings. x: (B, H, S, D) with even D; positions: (S,)."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d // 2, dtype=jnp.float32) / (d // 2))
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (S, D/2)
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., : d // 2], x[..., d // 2:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+        axis=-1).astype(x.dtype)
+
+
+def expand_kv_heads(kv: jnp.ndarray, num_heads: int) -> jnp.ndarray:
+    """GQA: repeat kv heads to match q heads. kv: (B, Hkv, S, D)."""
+    hkv = kv.shape[1]
+    if hkv == num_heads:
+        return kv
+    assert num_heads % hkv == 0
+    return jnp.repeat(kv, num_heads // hkv, axis=1)

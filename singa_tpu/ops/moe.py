@@ -1,0 +1,87 @@
+"""Mixture-of-Experts FFN with top-k routing — the expert-parallel op.
+
+New capability (no MoE in the reference).  Sort-based dispatch: the T·k
+(token, expert) assignments are sorted by expert id, ranked within each
+expert's run, capacity-clipped, and scattered into a dense
+(n_exp, capacity, E) expert batch; expert FFNs run batched over the
+leading expert dim and results scatter-add back per token.  Memory is
+O(T·k·E + n_exp·capacity·E) — linear in tokens, never the
+O(T·n_exp·capacity) one-hot dispatch tensor of naive GShard.
+
+Under expert parallelism the expert-stacked weights (and the expert
+batch) shard over the mesh's "expert" axis; XLA lowers the scatter/
+gather across that axis to all-to-alls over ICI.
+
+Router aux loss follows Switch Transformer (mean fraction × mean prob
+per expert, scaled by n_experts).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def moe_ffn(x: jnp.ndarray, params: Dict[str, jnp.ndarray], k: int = 2,
+            capacity_factor: float = 1.25,
+            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """x: (B, S, E).  params: router (E, n_exp); w1 (n_exp, E, F),
+    b1 (n_exp, F); w2 (n_exp, F, E), b2 (n_exp, E).
+
+    Returns (out (B, S, E), router aux loss).
+    """
+    b, s, e = x.shape
+    n_exp = params["router"].shape[1]
+    t = b * s
+    tokens = x.reshape(t, e)
+    capacity = max(int(capacity_factor * (t * k) / n_exp), 1)
+
+    logits = jnp.dot(tokens.astype(jnp.float32),
+                     params["router"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)                    # (T, n_exp)
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)              # (T, k)
+
+    # flatten assignments; row-major keeps rank-0 choices first per token
+    flat_exp = gate_idx.reshape(t * k)
+    flat_gate = gate_vals.reshape(t * k)
+    flat_tok = jnp.arange(t * k, dtype=jnp.int32) // k
+
+    # sort by expert (stable → earlier tokens keep queue priority)
+    order = jnp.argsort(flat_exp, stable=True)
+    sorted_exp = flat_exp[order]
+    # rank within each expert's contiguous run
+    onehot = (sorted_exp[:, None] ==
+              jnp.arange(n_exp, dtype=sorted_exp.dtype)[None, :])
+    rank = jnp.take_along_axis(
+        jnp.cumsum(onehot.astype(jnp.int32), axis=0) - 1,
+        sorted_exp[:, None].astype(jnp.int32), axis=1)[:, 0]
+    keep = rank < capacity
+    # dropped assignments write to a trash slot past the expert batch
+    slot = jnp.where(keep, sorted_exp * capacity + rank, n_exp * capacity)
+
+    tok_sorted = tokens[flat_tok[order]]                       # (T*k, E)
+    buf = jnp.zeros((n_exp * capacity + 1, e), x.dtype)
+    buf = buf.at[slot].set(tok_sorted)
+    exp_in = buf[:-1].reshape(n_exp, capacity, e)
+
+    h = jnp.einsum("ecd,edf->ecf", exp_in, params["w1"],
+                   preferred_element_type=jnp.float32)
+    h = jax.nn.silu(h + params["b1"][:, None, :])
+    out = jnp.einsum("ecf,efd->ecd", h.astype(x.dtype), params["w2"],
+                     preferred_element_type=jnp.float32)
+    out = out + params["b2"][:, None, :]
+
+    out_flat = jnp.concatenate(
+        [out.reshape(n_exp * capacity, e), jnp.zeros((1, e), out.dtype)])
+    out_sorted = out_flat[slot] * flat_gate[order][:, None]
+    y = jnp.zeros((t, e), jnp.float32).at[flat_tok[order]].add(
+        out_sorted.astype(jnp.float32))
+
+    # Switch aux loss over rank-0 assignments
+    frac_tokens = jnp.mean(
+        jax.nn.one_hot(gate_idx[:, 0], n_exp, dtype=jnp.float32), axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = n_exp * jnp.sum(frac_tokens * frac_probs)
+    return y.reshape(b, s, e).astype(x.dtype), aux

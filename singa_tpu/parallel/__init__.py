@@ -1,3 +1,33 @@
+"""Parallelism: device mesh, shardings, SP/PP strategies, elastic tier.
+
+Sequence/pipeline strategies import lazily — they pull in Pallas and are
+only needed when a model actually uses them.
+"""
+
 from .mesh import AXES, make_mesh, mesh_from_cluster
-from .partition import (param_shardings, batch_shardings, shard_params,
+from .partition import (param_shardings, batch_shardings,
+                        seq_batch_shardings, shard_params,
                         shard_opt_state, shard_batch, replicated)
+
+_LAZY = {
+    "ring_attention": ("sequence", "ring_attention"),
+    "ulysses_attention": ("sequence", "ulysses_attention"),
+    "pipeline_apply": ("pipeline", "pipeline_apply"),
+    "stack_stage_params": ("pipeline", "stack_stage_params"),
+    "ElasticController": ("elastic", "ElasticController"),
+    "elastic_update": ("elastic", "elastic_update"),
+    "randomsync_update": ("elastic", "randomsync_update"),
+    "sync_sample_ratio": ("elastic", "sync_sample_ratio"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        module, attr = _LAZY[name]
+        return getattr(importlib.import_module(f".{module}", __name__), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))

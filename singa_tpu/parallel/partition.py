@@ -33,13 +33,14 @@ def param_shardings(mesh: Mesh, net: NeuralNet,
     """Per-param NamedSharding from ParamProto.partition_dim + the layer
     defaults (weights partition on the neuron dim under kLayerPartition,
     base_layer.h:121-128)."""
-    tp = mesh.shape[tp_axis]
     out = {}
     for name, spec in net.param_specs.items():
+        axis = spec.mesh_axis or tp_axis
+        n = mesh.shape[axis]
         dim = spec.partition_dim
-        if tp > 1 and dim >= 0 and spec.shape[dim] % tp == 0:
+        if n > 1 and dim >= 0 and spec.shape[dim] % n == 0:
             axes: list = [None] * len(spec.shape)
-            axes[dim] = tp_axis
+            axes[dim] = axis
             out[name] = NamedSharding(mesh, P(*axes))
         else:
             out[name] = replicated(mesh)
@@ -50,6 +51,18 @@ def batch_shardings(mesh: Mesh, batch_tree: Any,
                     data_axis: str = "data") -> Any:
     """Shard every leaf's dim 0 (batch) over the data axis."""
     def leaf(x):
+        return NamedSharding(mesh, P(data_axis))
+    return jax.tree_util.tree_map(leaf, batch_tree)
+
+
+def seq_batch_shardings(mesh: Mesh, batch_tree: Any,
+                        data_axis: str = "data",
+                        seq_axis: str = "seq") -> Any:
+    """Token batches (B, S): shard batch over data AND sequence over seq
+    — the input layout for ring/Ulysses sequence parallelism."""
+    def leaf(x):
+        if getattr(x, "ndim", 0) >= 2:
+            return NamedSharding(mesh, P(data_axis, seq_axis))
         return NamedSharding(mesh, P(data_axis))
     return jax.tree_util.tree_map(leaf, batch_tree)
 

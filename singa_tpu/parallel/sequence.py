@@ -1,0 +1,108 @@
+"""Sequence/context parallelism: ring attention + Ulysses.
+
+New first-class capability (SURVEY.md §5: the reference has no sequence
+axis; its closest mechanism is generic layer partitioning).  Both
+strategies shard the sequence axis of (B, H, S, D) attention inputs over
+the mesh's "seq" axis — and keep the batch dim on "data" and the head
+dim on "model", so they compose with data/tensor parallelism on the same
+mesh instead of gathering the global batch onto every device:
+
+- **Ring attention** (blockwise attention + KV rotation): each device
+  keeps its Q chunk and rotates KV chunks around the ring with
+  `jax.lax.ppermute` (XLA collective-permute over ICI), merging partial
+  attention results in log-sum-exp space.  Memory per device is O(S/n).
+
+- **Ulysses**: two `all_to_all`s re-shard seq→heads, run dense local
+  attention on H/(sp·tp) heads at full sequence length, then shard back.
+  Cheaper comm volume for moderate S; needs H/tp divisible by sp.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from ..ops.attention import (NEG_INF, attention_reference, chunk_attention,
+                             merge_attention)
+
+
+def _spec(mesh: Mesh, seq_axis: str, heads: int):
+    """(B, H, S, D): batch on data, heads on model (when divisible), seq
+    on the sequence axis."""
+    head_axis = "model" if heads % mesh.shape["model"] == 0 else None
+    return P("data", head_axis, seq_axis, None)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
+                   causal: bool = True) -> jnp.ndarray:
+    """q/k/v: (B, H, S, D) with S sharded over `axis`.  Returns attention
+    output with the same sharding."""
+    nseq = mesh.shape[axis]
+    if nseq == 1:
+        return attention_reference(q, k, v, causal)
+    spec = _spec(mesh, axis, q.shape[1])
+
+    def local(q, k, v):
+        idx = jax.lax.axis_index(axis)
+        chunk = q.shape[2]
+        q_off = idx * chunk
+
+        def step(carry, s):
+            k_cur, v_cur, out, lse = carry
+            src = jax.lax.rem(idx - s + nseq, nseq)  # owner of current kv
+            o_new, lse_new = chunk_attention(q, k_cur, v_cur, causal,
+                                             q_off, src * chunk)
+            out, lse = merge_attention(out, lse, o_new, lse_new)
+            # rotate kv to the next device (ring over ICI)
+            perm = [(i, (i + 1) % nseq) for i in range(nseq)]
+            k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+            v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+            return (k_nxt, v_nxt, out, lse), None
+
+        out0 = jnp.zeros(q.shape, jnp.float32)
+        lse0 = jnp.full(q.shape[:3] + (1,), NEG_INF, jnp.float32)
+        (k, v, out, lse), _ = jax.lax.scan(
+            step, (k, v, out0, lse0), jnp.arange(nseq))
+        return out.astype(q.dtype)
+
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "seq",
+                      causal: bool = True,
+                      attn_fn=None) -> jnp.ndarray:
+    """Ulysses SP: all-to-all seq→heads, local full-sequence attention,
+    all-to-all back.  q/k/v: (B, H, S, D), S sharded over `axis`."""
+    nseq = mesh.shape[axis]
+    if attn_fn is None:
+        attn_fn = attention_reference
+    if nseq == 1:
+        return attn_fn(q, k, v, causal)
+    h = q.shape[1]
+    tp = mesh.shape["model"]
+    h_local = h // tp if h % tp == 0 and tp > 1 else h
+    if h_local % nseq:
+        raise ValueError(
+            f"Ulysses needs heads ({h}"
+            f"{f'/tp={tp}' if tp > 1 and h % tp == 0 else ''}) "
+            f"% seq axis ({nseq}) == 0")
+
+    spec = _spec(mesh, axis, h)
+
+    def local(q, k, v):
+        def to_heads(x):   # (B, H, S/n, D) -> (B, H/n, S, D)
+            return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                      tiled=True)
+
+        def to_seq(x):     # (B, H/n, S, D) -> (B, H, S/n, D)
+            return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                      tiled=True)
+
+        out = attn_fn(to_heads(q), to_heads(k), to_heads(v), causal)
+        return to_seq(out)
+
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)
