@@ -38,6 +38,10 @@ def make_argparser() -> argparse.ArgumentParser:
     ap.add_argument("--steps", type=int, default=None,
                     help="override ModelProto.train_steps")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--resume", action="store_true",
+                    help="resume from latest checkpoint in the workspace")
+    ap.add_argument("--workspace", default=None,
+                    help="override ClusterProto.workspace")
     return ap
 
 
@@ -59,6 +63,26 @@ def main(argv=None) -> int:
     trainer = Trainer(model, input_shapes)
     params, opt_state = trainer.init(seed=args.seed)
 
+    workspace = args.workspace or (cluster.workspace if cluster else None)
+    # an explicit --workspace is a request to checkpoint: default to a
+    # final snapshot when the config doesn't set a cadence
+    if args.workspace and model.checkpoint_frequency == 0:
+        model.checkpoint_frequency = max(model.train_steps, 1)
+    start_step = 0
+    if args.resume:
+        if not workspace:
+            print("warning: --resume given but no workspace configured "
+                  "(set --workspace or ClusterProto.workspace); "
+                  "starting from scratch", file=sys.stderr)
+        else:
+            params, opt_state, start_step = trainer.resume(
+                params, opt_state, workspace)
+            if start_step > 0:
+                print(f"resumed from step {start_step}")
+            else:
+                print(f"no checkpoint found in {workspace}; "
+                      "starting from scratch")
+
     train_layer = next(
         (l for l in model.neuralnet.layer
          if l.type in ("kShardData", "kLMDBData") and "kTrain" not in l.exclude),
@@ -73,8 +97,10 @@ def main(argv=None) -> int:
 
     params, opt_state, history = trainer.run(
         params, opt_state, train_iter, test_iter_factory=test_factory,
-        seed=args.seed)
-    print("training done:", trainer.perf.to_string() or "(no metrics)")
+        seed=args.seed, start_step=start_step, workspace=workspace)
+    final = trainer.perf.to_string()
+    print("training done" + (f": {final}" if final else
+                             f" at step {model.train_steps}"))
     return 0
 
 

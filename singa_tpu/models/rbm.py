@@ -1,0 +1,159 @@
+"""RBM / autoencoder pretraining — the kContrastiveDivergence path.
+
+The reference declares GradCalcAlg::kContrastiveDivergence
+(model.proto:40-44) and its BASELINE configs name "RBM / autoencoder
+pretraining (layer-wise greedy)", but the 2015 code never implemented a
+CD worker.  Here it is, TPU-native: the CD-k Gibbs chain is a
+`lax.scan` inside one jitted step (binary units, sigmoid activations),
+so pretraining runs entirely on device.
+
+Greedy stacking follows the classic recipe (Hinton & Salakhutdinov
+2006): train RBM_i on the hidden probabilities of RBM_{i-1}, then unroll
+into a deep autoencoder (decoder = tied transposed weights) whose
+fine-tuning uses the ordinary net/trainer path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init_rbm(rng: jax.Array, nvis: int, nhid: int,
+             std: float = 0.01) -> Dict[str, jnp.ndarray]:
+    wkey, = jax.random.split(rng, 1)
+    return {
+        "W": std * jax.random.normal(wkey, (nvis, nhid), jnp.float32),
+        "bv": jnp.zeros((nvis,), jnp.float32),
+        "bh": jnp.zeros((nhid,), jnp.float32),
+    }
+
+
+def _h_prob(params, v):
+    return jax.nn.sigmoid(v @ params["W"] + params["bh"])
+
+
+def _v_prob(params, h):
+    return jax.nn.sigmoid(h @ params["W"].T + params["bv"])
+
+
+def free_energy(params, v):
+    """F(v) = -v·bv - Σ softplus(vW + bh)."""
+    return (-v @ params["bv"]
+            - jnp.sum(jax.nn.softplus(v @ params["W"] + params["bh"]),
+                      axis=-1))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "persistent"))
+def cd_grads(params, v0, rng, k: int = 1,
+             persistent: Optional[jnp.ndarray] = None,
+             ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray, jnp.ndarray]:
+    """CD-k gradients.  Returns (grads, reconstruction_error, chain_end).
+
+    grads follow the *descent* convention (apply with params -= lr*grad)
+    so they plug into the Updater family directly.
+    """
+    b = v0.shape[0]
+    h0_prob = _h_prob(params, v0)
+    start = persistent if persistent is not None else v0
+
+    def gibbs(carry, key):
+        v, _ = carry
+        kh, kv = jax.random.split(key)
+        h_prob = _h_prob(params, v)
+        h = jax.random.bernoulli(kh, h_prob).astype(jnp.float32)
+        v_prob = _v_prob(params, h)
+        v_new = jax.random.bernoulli(kv, v_prob).astype(jnp.float32)
+        return (v_new, v_prob), None
+
+    keys = jax.random.split(rng, k)
+    (vk, vk_prob), _ = jax.lax.scan(gibbs, (start, start), keys)
+    hk_prob = _h_prob(params, vk_prob)
+
+    # <v0 h0> - <vk hk>, sign-flipped to descent convention
+    gW = -(v0.T @ h0_prob - vk_prob.T @ hk_prob) / b
+    gbv = -jnp.mean(v0 - vk_prob, axis=0)
+    gbh = -jnp.mean(h0_prob - hk_prob, axis=0)
+    recon = jnp.mean(jnp.square(v0 - _v_prob(params, h0_prob)))
+    return {"W": gW, "bv": gbv, "bh": gbh}, recon, vk
+
+
+def pretrain_rbm(rng: jax.Array, data_iter, nvis: int, nhid: int,
+                 steps: int = 1000, lr: float = 0.1, k: int = 1,
+                 momentum: float = 0.5,
+                 log_every: int = 0, log_fn=print) -> Dict[str, jnp.ndarray]:
+    """Train one RBM with CD-k + momentum SGD on binary-ish data in [0,1]."""
+    params = init_rbm(rng, nvis, nhid)
+    vel = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+    @jax.jit
+    def sgd(params, vel, grads):
+        vel = jax.tree_util.tree_map(
+            lambda m, g: momentum * m + lr * g, vel, grads)
+        params = jax.tree_util.tree_map(lambda p, m: p - m, params, vel)
+        return params, vel
+
+    for step in range(steps):
+        v0 = next(data_iter)
+        grads, recon, _ = cd_grads(params, v0,
+                                   jax.random.fold_in(rng, step), k=k)
+        params, vel = sgd(params, vel, grads)
+        if log_every and step % log_every == 0:
+            log_fn(f"rbm step-{step}: recon {float(recon):.5f}")
+    return params
+
+
+def greedy_pretrain(rng: jax.Array, data_factory, widths: Sequence[int],
+                    nvis: int, steps_per_layer: int = 1000, lr: float = 0.1,
+                    k: int = 1, log_fn=print) -> List[Dict[str, jnp.ndarray]]:
+    """Stack RBMs greedily: each trained on the previous layer's hidden
+    probabilities."""
+    rbms: List[Dict[str, jnp.ndarray]] = []
+    sizes = [nvis] + list(widths)
+
+    def lifted_iter():
+        it = data_factory()
+        while True:
+            v = next(it)
+            for p in rbms:
+                v = _h_prob(p, v)
+            yield v
+
+    for i, (nv, nh) in enumerate(zip(sizes[:-1], sizes[1:])):
+        log_fn(f"pretraining RBM {i}: {nv} -> {nh}")
+        rbms.append(pretrain_rbm(jax.random.fold_in(rng, i), lifted_iter(),
+                                 nv, nh, steps_per_layer, lr, k))
+    return rbms
+
+
+def unroll_autoencoder(rbms: List[Dict[str, jnp.ndarray]]
+                       ) -> Dict[str, jnp.ndarray]:
+    """Unroll stacked RBMs into deep-autoencoder params: encoder layers
+    enc_i/{weight,bias} and tied decoder layers dec_i/{weight,bias}
+    (decoder weight = encoder transpose, per Hinton's unrolling)."""
+    params = {}
+    n = len(rbms)
+    for i, p in enumerate(rbms):
+        params[f"enc{i}/weight"] = p["W"]
+        params[f"enc{i}/bias"] = p["bh"]
+        params[f"dec{n - 1 - i}/weight"] = p["W"].T
+        params[f"dec{n - 1 - i}/bias"] = p["bv"]
+    return params
+
+
+def autoencoder_apply(params: Dict[str, jnp.ndarray], v: jnp.ndarray,
+                      nlayers: int) -> jnp.ndarray:
+    """Forward through the unrolled autoencoder (sigmoid units).  The
+    returned reconstruction is differentiable — fine-tune with jax.grad
+    on e.g. mean-square or cross-entropy reconstruction loss."""
+    h = v
+    for i in range(nlayers):
+        h = jax.nn.sigmoid(h @ params[f"enc{i}/weight"]
+                           + params[f"enc{i}/bias"])
+    for i in range(nlayers):
+        h = jax.nn.sigmoid(h @ params[f"dec{i}/weight"]
+                           + params[f"dec{i}/bias"])
+    return h

@@ -1,0 +1,2 @@
+from .checkpoint import CheckpointManager, load_pretrained
+from .profiler import trace, StepTimer, flops_of

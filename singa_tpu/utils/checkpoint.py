@@ -1,0 +1,119 @@
+"""Checkpoint / resume — finishing what the reference designed but never
+implemented (Worker::Resume is an empty TODO, worker.cc:65-67;
+Blob::ToProto/FromProto commented out, blob.cc:300-320; ModelProto.step
+"last snapshot step", model.proto:34-35; kPretrained init,
+model.proto:78-79).
+
+Backed by orbax (the TPU-native checkpoint format: sharded-array aware,
+atomic renames).  A checkpoint holds {params, opt_state, step} — the
+same state triple the reference intended to snapshot (Param data_ +
+history_ + step).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+try:
+    import orbax.checkpoint as ocp
+    _HAVE_ORBAX = True
+except Exception:  # pragma: no cover
+    _HAVE_ORBAX = False
+
+
+class CheckpointManager:
+    """Save/restore the training state triple under `workspace/checkpoints`
+    (the reference's ClusterProto.workspace layout, cluster.proto:10-12)."""
+
+    def __init__(self, workspace: str, max_to_keep: int = 3):
+        self.dir = os.path.abspath(os.path.join(workspace, "checkpoints"))
+        os.makedirs(self.dir, exist_ok=True)
+        if _HAVE_ORBAX:
+            self._mgr = ocp.CheckpointManager(
+                self.dir,
+                options=ocp.CheckpointManagerOptions(
+                    max_to_keep=max_to_keep, create=True))
+        else:
+            self._mgr = None
+
+    def save(self, step: int, params: Dict[str, Any],
+             opt_state: Dict[str, Any]) -> None:
+        state = {"params": params, "opt_state": opt_state,
+                 "step": np.asarray(step)}
+        if self._mgr is not None:
+            self._mgr.save(step, args=ocp.args.StandardSave(state))
+            self._mgr.wait_until_finished()
+        else:  # numpy fallback
+            path = os.path.join(self.dir, f"step_{step}.npz")
+            flat = _flatten("", state)
+            np.savez(path, **{k: np.asarray(v) for k, v in flat.items()})
+
+    def latest_step(self) -> Optional[int]:
+        if self._mgr is not None:
+            return self._mgr.latest_step()
+        steps = [int(f[5:-4]) for f in os.listdir(self.dir)
+                 if f.startswith("step_") and f.endswith(".npz")]
+        return max(steps) if steps else None
+
+    def restore(self, step: Optional[int] = None,
+                template: Optional[Dict[str, Any]] = None
+                ) -> Optional[Tuple[Dict, Dict, int]]:
+        """Returns (params, opt_state, step) or None if no checkpoint."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return None
+        if self._mgr is not None:
+            if template is not None:
+                target = {"params": template["params"],
+                          "opt_state": template["opt_state"],
+                          "step": np.asarray(0)}
+                state = self._mgr.restore(
+                    step, args=ocp.args.StandardRestore(target))
+            else:
+                state = self._mgr.restore(step)
+            return state["params"], state["opt_state"], int(state["step"])
+        path = os.path.join(self.dir, f"step_{step}.npz")
+        data = np.load(path)
+        state = _unflatten(dict(data.items()))
+        return state["params"], state["opt_state"], int(state["step"])
+
+
+def _flatten(prefix: str, tree) -> Dict[str, Any]:
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(f"{prefix}{k}|", v))
+    else:
+        out[prefix.rstrip("|")] = tree
+    return out
+
+
+def _unflatten(flat: Dict[str, Any]):
+    root: Dict[str, Any] = {}
+    for key, v in flat.items():
+        parts = key.split("|")
+        d = root
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = v
+    return root
+
+
+def load_pretrained(workspace: str, params: Dict[str, Any],
+                    opt_state: Dict[str, Any]
+                    ) -> Tuple[Dict[str, Any], Dict[str, Any], int]:
+    """kPretrained init (param.cc model.proto:78-79): overwrite
+    freshly-initialized params with the latest checkpoint, keeping any
+    params absent from the snapshot (e.g. a new head)."""
+    mgr = CheckpointManager(workspace)
+    restored = mgr.restore(template={"params": params,
+                                     "opt_state": opt_state})
+    if restored is None:
+        return params, opt_state, 0
+    rp, ro, step = restored
+    merged = {**params, **{k: v for k, v in rp.items() if k in params}}
+    return merged, ro, step

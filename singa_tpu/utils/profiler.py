@@ -1,0 +1,69 @@
+"""Profiling — the reference's TimerInfo phase report (worker.h:91-114)
+plus TPU-native jax.profiler traces.
+
+The reference accumulates tForward_/tBackward_/tSyncData_/tSyncParam_
+around each phase and prints "% of step per phase".  Under XLA the
+fwd/bwd/update are one fused program, so the phase split comes from the
+profiler trace instead; the host-visible split (data wait vs device
+step) is kept in trainer.TimerInfo with the same report format.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """Capture a jax.profiler trace viewable in TensorBoard/XProf."""
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Wall-clock step timing with compile-step exclusion."""
+
+    def __init__(self, skip_first: int = 1):
+        self.skip = skip_first
+        self.times = []
+        self._t0: Optional[float] = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        if self.skip > 0:
+            self.skip -= 1
+        else:
+            self.times.append(dt)
+
+    def mean(self) -> float:
+        return sum(self.times) / max(len(self.times), 1)
+
+    def steps_per_sec(self) -> float:
+        m = self.mean()
+        return 1.0 / m if m else 0.0
+
+
+def flops_of(fn, *args) -> Optional[float]:
+    """Analytical FLOP estimate of a jitted function via XLA cost
+    analysis — used for MFU reporting in bench.py."""
+    try:
+        lowered = jax.jit(fn).lower(*args)
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0]
+        return float(cost.get("flops", 0.0)) if cost else None
+    except Exception:
+        return None
