@@ -1,0 +1,197 @@
+// Native shard record store — C++ core for the hot data path.
+//
+// Binary-compatible with the reference's Shard format
+// (/root/reference/src/utils/shard.cc): tuples of
+//   [uint64 keylen][key][uint64 vallen][val]
+// in <folder>/shard.dat, with duplicate-key rejection and torn-tail
+// truncation on append.  This is the TPU build's native equivalent of
+// the reference's C++ shard reader feeding the input pipeline; Python
+// binds via ctypes (singa_tpu/data/native.py) with a pure-Python
+// fallback when the extension is unavailable.
+//
+// Build: make -C native   (g++ -O2 -shared -fPIC)
+
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+struct Reader {
+  FILE* f = nullptr;
+  std::vector<uint8_t> key_buf;
+  std::vector<uint8_t> val_buf;
+};
+
+struct Writer {
+  FILE* f = nullptr;
+  std::unordered_set<std::string> keys;
+};
+
+bool read_u64(FILE* f, uint64_t* out) {
+  return fread(out, sizeof(uint64_t), 1, f) == 1;
+}
+
+// Remaining bytes from the current position — used to bound length
+// fields before allocating, so a corrupt header reads as a torn tail
+// instead of a std::bad_alloc aborting through the C boundary.
+uint64_t bytes_left(FILE* f) {
+  long pos = ftell(f);
+  fseek(f, 0, SEEK_END);
+  long end = ftell(f);
+  fseek(f, pos, SEEK_SET);
+  return pos < 0 || end < pos ? 0 : static_cast<uint64_t>(end - pos);
+}
+
+// Scan for the end of the last complete tuple; fill `keys` if non-null.
+long scan_valid_prefix(FILE* f, std::unordered_set<std::string>* keys) {
+  long last_ok = 0;
+  uint64_t klen, vlen;
+  std::vector<char> kbuf;
+  for (;;) {
+    if (!read_u64(f, &klen)) break;
+    if (klen > bytes_left(f)) break;
+    kbuf.resize(klen);
+    if (klen && fread(kbuf.data(), 1, klen, f) != klen) break;
+    if (!read_u64(f, &vlen)) break;
+    if (vlen > bytes_left(f)) break;
+    if (fseek(f, static_cast<long>(vlen), SEEK_CUR) != 0) break;
+    long pos = ftell(f);
+    // confirm the value bytes were really present
+    fseek(f, 0, SEEK_END);
+    long end = ftell(f);
+    if (pos > end) break;
+    fseek(f, pos, SEEK_SET);
+    if (keys) keys->emplace(kbuf.data(), klen);
+    last_ok = pos;
+  }
+  return last_ok;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------- reader ----------
+
+void* shard_open_read(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  auto* r = new Reader();
+  r->f = f;
+  return r;
+}
+
+// Returns 1 on success, 0 at EOF/torn tail. Key/val pointers stay valid
+// until the next call.
+int shard_next(void* handle, const uint8_t** key, uint64_t* klen,
+               const uint8_t** val, uint64_t* vlen) {
+  auto* r = static_cast<Reader*>(handle);
+  uint64_t kl, vl;
+  if (!read_u64(r->f, &kl)) return 0;
+  if (kl > bytes_left(r->f)) return 0;
+  r->key_buf.resize(kl);
+  if (kl && fread(r->key_buf.data(), 1, kl, r->f) != kl) return 0;
+  if (!read_u64(r->f, &vl)) return 0;
+  if (vl > bytes_left(r->f)) return 0;
+  r->val_buf.resize(vl);
+  if (vl && fread(r->val_buf.data(), 1, vl, r->f) != vl) return 0;
+  *key = r->key_buf.data();
+  *klen = kl;
+  *val = r->val_buf.data();
+  *vlen = vl;
+  return 1;
+}
+
+void shard_seek_first(void* handle) {
+  fseek(static_cast<Reader*>(handle)->f, 0, SEEK_SET);
+}
+
+long shard_count(void* handle) {
+  auto* r = static_cast<Reader*>(handle);
+  long pos = ftell(r->f);
+  fseek(r->f, 0, SEEK_SET);
+  long n = 0;
+  uint64_t kl, vl;
+  for (;;) {
+    if (!read_u64(r->f, &kl)) break;
+    if (fseek(r->f, static_cast<long>(kl), SEEK_CUR) != 0) break;
+    if (!read_u64(r->f, &vl)) break;
+    long want = ftell(r->f) + static_cast<long>(vl);
+    fseek(r->f, 0, SEEK_END);
+    if (ftell(r->f) < want) break;
+    fseek(r->f, want, SEEK_SET);
+    ++n;
+  }
+  fseek(r->f, pos, SEEK_SET);
+  return n;
+}
+
+void shard_close_read(void* handle) {
+  auto* r = static_cast<Reader*>(handle);
+  if (r->f) fclose(r->f);
+  delete r;
+}
+
+// ---------- writer ----------
+
+// mode: 0 = create (truncate), 1 = append (truncate torn tail, load keys)
+void* shard_open_write(const char* path, int mode) {
+  auto* w = new Writer();
+  if (mode == 0) {
+    w->f = fopen(path, "wb");
+  } else {
+    FILE* scan = fopen(path, "rb");
+    long last_ok = 0;
+    if (scan) {
+      last_ok = scan_valid_prefix(scan, &w->keys);
+      fclose(scan);
+    } else {
+      FILE* create = fopen(path, "wb");
+      if (create) fclose(create);
+    }
+    w->f = fopen(path, "r+b");
+    if (w->f) {
+#ifdef _WIN32
+      _chsize(fileno(w->f), last_ok);
+#else
+      if (ftruncate(fileno(w->f), last_ok) != 0) { /* keep going */ }
+#endif
+      fseek(w->f, last_ok, SEEK_SET);
+    }
+  }
+  if (!w->f) {
+    delete w;
+    return nullptr;
+  }
+  return w;
+}
+
+// Returns 1 if inserted, 0 if duplicate key or empty value.
+int shard_insert(void* handle, const uint8_t* key, uint64_t klen,
+                 const uint8_t* val, uint64_t vlen) {
+  auto* w = static_cast<Writer*>(handle);
+  if (vlen == 0) return 0;
+  std::string k(reinterpret_cast<const char*>(key), klen);
+  if (!w->keys.insert(k).second) return 0;
+  fwrite(&klen, sizeof(uint64_t), 1, w->f);
+  fwrite(key, 1, klen, w->f);
+  fwrite(&vlen, sizeof(uint64_t), 1, w->f);
+  fwrite(val, 1, vlen, w->f);
+  return 1;
+}
+
+void shard_flush(void* handle) { fflush(static_cast<Writer*>(handle)->f); }
+
+void shard_close_write(void* handle) {
+  auto* w = static_cast<Writer*>(handle);
+  if (w->f) fclose(w->f);
+  delete w;
+}
+
+}  // extern "C"

@@ -1,0 +1,126 @@
+"""ctypes binding for the native shard store (native/shard_store.cc).
+
+The hot data path runs in C++ (like the reference's shard reader,
+shard.cc); Python falls back to the pure implementation in
+singa_tpu.data.shard when the shared library hasn't been built.
+Build with `make -C native`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Iterator, Optional, Tuple
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "..", "..",
+                         "native", "libsinga_native.so")
+_lib = None
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = os.path.abspath(_LIB_PATH)
+    if not os.path.exists(path):
+        return None
+    lib = ctypes.CDLL(path)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.shard_open_read.restype = ctypes.c_void_p
+    lib.shard_open_read.argtypes = [ctypes.c_char_p]
+    lib.shard_next.restype = ctypes.c_int
+    lib.shard_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(u8p),
+                               ctypes.POINTER(ctypes.c_uint64),
+                               ctypes.POINTER(u8p),
+                               ctypes.POINTER(ctypes.c_uint64)]
+    lib.shard_seek_first.argtypes = [ctypes.c_void_p]
+    lib.shard_count.restype = ctypes.c_long
+    lib.shard_count.argtypes = [ctypes.c_void_p]
+    lib.shard_close_read.argtypes = [ctypes.c_void_p]
+    lib.shard_open_write.restype = ctypes.c_void_p
+    lib.shard_open_write.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.shard_insert.restype = ctypes.c_int
+    lib.shard_insert.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_uint64, ctypes.c_char_p,
+                                 ctypes.c_uint64]
+    lib.shard_flush.argtypes = [ctypes.c_void_p]
+    lib.shard_close_write.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return load_library() is not None
+
+
+class NativeShardReader:
+    """Iterates (key, val) tuples via the C++ reader."""
+
+    def __init__(self, folder: str):
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("native shard library not built "
+                               "(run `make -C native`)")
+        self._lib = lib
+        path = os.path.join(folder, "shard.dat").encode()
+        self._h = lib.shard_open_read(path)
+        if not self._h:
+            raise IOError(f"cannot open shard at {folder!r}")
+
+    def __iter__(self) -> Iterator[Tuple[bytes, bytes]]:
+        self._lib.shard_seek_first(self._h)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        key_p, val_p = u8p(), u8p()
+        klen, vlen = ctypes.c_uint64(), ctypes.c_uint64()
+        while self._lib.shard_next(self._h, ctypes.byref(key_p),
+                                   ctypes.byref(klen), ctypes.byref(val_p),
+                                   ctypes.byref(vlen)):
+            yield (ctypes.string_at(key_p, klen.value),
+                   ctypes.string_at(val_p, vlen.value))
+
+    def count(self) -> int:
+        return self._lib.shard_count(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.shard_close_read(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class NativeShardWriter:
+    def __init__(self, folder: str, append: bool = False):
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("native shard library not built")
+        self._lib = lib
+        path = os.path.join(folder, "shard.dat").encode()
+        self._h = lib.shard_open_write(path, 1 if append else 0)
+        if not self._h:
+            raise IOError(f"cannot open shard for write at {folder!r}")
+
+    def insert(self, key: bytes | str, val: bytes) -> bool:
+        if isinstance(key, str):
+            key = key.encode()
+        return bool(self._lib.shard_insert(self._h, key, len(key),
+                                           val, len(val)))
+
+    def flush(self) -> None:
+        self._lib.shard_flush(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.shard_flush(self._h)
+            self._lib.shard_close_write(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,125 @@
+"""Asynchronous multi-replica consistency tier: Elastic averaging +
+RandomSync — the reference parameter server's two sync *algorithms*,
+preserved as first-class capability.
+
+Reference semantics:
+- **Elastic** (EASGD, param.cc:216-256): each replica periodically
+  exchanges with a center copy: diff = (replica - center) * alpha;
+  center += diff; replica -= diff; alpha = moving_rate / ngroups
+  (param_manager.cc:15).  Cadence: UpdaterProto.sync_frequency after
+  warmup_steps (model.proto:336-338, worker.cc:44-55).
+- **RandomSync** (param.cc:102-213): the replica sends a seeded random
+  *sample* of (data - snapshot) deltas; the center adds the deltas and
+  returns its old values; the replica overwrites sampled entries with
+  the center values and updates its snapshot.  The sample size follows
+  the bandwidth model (param_manager.cc:85-93).
+
+On TPU the synchronous psum path inside the compiled step replaces the
+PS for intra-slice gradients; this module is the *cross-slice* tier
+(slices connected over DCN, where async/compressed sync still pays).
+The math is pure pytree ops, so it runs under jit on whatever process
+holds the center copy; transport across hosts is jax.distributed /
+multi-slice runtime.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config.schema import UpdaterConfig
+
+
+def elastic_update(replica, center, alpha: float):
+    """One EASGD exchange (param.cc:232-256). Returns (replica, center)."""
+    def one(r, c):
+        diff = (r - c) * alpha
+        return r - diff, c + diff
+    pairs = jax.tree_util.tree_map(one, replica, center)
+    new_r = jax.tree_util.tree_map(lambda p: p[0], pairs,
+                                   is_leaf=lambda x: isinstance(x, tuple))
+    new_c = jax.tree_util.tree_map(lambda p: p[1], pairs,
+                                   is_leaf=lambda x: isinstance(x, tuple))
+    return new_r, new_c
+
+
+def randomsync_update(replica, center, snapshot, sample_ratio: float,
+                      rng: jax.Array):
+    """One RandomSync exchange (param.cc:102-213).
+
+    A seeded uniform mask selects ~sample_ratio of entries; the center
+    absorbs the replica's masked delta vs snapshot, the replica adopts
+    the center's resulting values at the mask, and the snapshot records
+    them.  Returns (replica, center, snapshot).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(replica)
+    keys = jax.random.split(rng, len(leaves))
+    c_leaves = jax.tree_util.tree_leaves(center)
+    s_leaves = jax.tree_util.tree_leaves(snapshot)
+    new_r, new_c, new_s = [], [], []
+    for r, c, s, k in zip(leaves, c_leaves, s_leaves, keys):
+        mask = (jax.random.uniform(k, r.shape) < sample_ratio
+                ).astype(r.dtype)
+        delta = (r - s) * mask
+        c2 = c + delta
+        r2 = r * (1 - mask) + c2 * mask
+        s2 = s * (1 - mask) + c2 * mask
+        new_r.append(r2)
+        new_c.append(c2)
+        new_s.append(s2)
+    un = jax.tree_util.tree_unflatten
+    return un(treedef, new_r), un(treedef, new_c), un(treedef, new_s)
+
+
+def sync_sample_ratio(bandwidth_mb_s: float, nservers: int, nworkers: int,
+                      model_size_floats: int, compute_time_s: float) -> float:
+    """Bandwidth-adaptive sample ratio (param_manager.cc:85-93):
+    the fraction of the model that fits through the pipe per step."""
+    if model_size_floats <= 0 or compute_time_s <= 0:
+        return 1.0
+    throughput = bandwidth_mb_s * 1e6 / 4.0 * nservers   # floats/sec
+    demand = model_size_floats * nworkers / compute_time_s
+    return float(max(0.0, min(1.0, throughput / demand)))
+
+
+class ElasticController:
+    """Cross-slice consistency driver with the reference's cadence knobs.
+
+    One instance lives on the coordinating process; `maybe_sync` is
+    called each step with that slice's params.
+    """
+
+    def __init__(self, cfg: UpdaterConfig, ngroups: int = 1):
+        self.cfg = cfg
+        self.alpha = (cfg.moving_rate / max(ngroups, 1)
+                      if cfg.moving_rate else 0.0)
+        self.mode = cfg.param_type           # "Elastic" | "RandomSync"
+        self.center = None
+        self.snapshot = None
+        self.sample_ratio = 1.0
+
+    def init(self, params) -> None:
+        self.center = jax.tree_util.tree_map(jnp.copy, params)
+        if self.mode == "RandomSync":
+            self.snapshot = jax.tree_util.tree_map(jnp.copy, params)
+
+    def sync_now(self, step: int) -> bool:
+        """warmup_steps then every sync_frequency (worker.cc:44-55)."""
+        return (step >= self.cfg.warmup_steps
+                and self.cfg.sync_frequency > 0
+                and (step - self.cfg.warmup_steps)
+                % self.cfg.sync_frequency == 0)
+
+    def maybe_sync(self, step: int, params, rng=None):
+        if self.center is None or not self.sync_now(step):
+            return params
+        if self.mode == "RandomSync":
+            rng = rng if rng is not None else jax.random.PRNGKey(step)
+            params, self.center, self.snapshot = randomsync_update(
+                params, self.center, self.snapshot, self.sample_ratio, rng)
+        else:
+            params, self.center = elastic_update(params, self.center,
+                                                 self.alpha)
+        return params

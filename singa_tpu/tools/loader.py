@@ -1,0 +1,119 @@
+"""Data loader tool — the reference's `loader` binary
+(/root/reference/tools/data_loader/data_loader.cc).
+
+Modes (same surface):
+  create: convert MNIST idx files or a CIFAR-10 binary folder into a
+          Shard of Record protos (data_loader.cc:112-145)
+  split:  re-partition a shard into N sub-shards (Split/SplitN,
+          data_loader.cc:43-94)
+
+Usage:
+  python -m singa_tpu.tools.loader create mnist  <images.idx> <labels.idx> <out_folder>
+  python -m singa_tpu.tools.loader create cifar10 <data_batch.bin...> <out_folder>
+  python -m singa_tpu.tools.loader split <in_folder> <out_prefix> <n>
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import sys
+from typing import Iterator, List, Tuple
+
+import numpy as np
+
+from ..data.records import Record, SingleLabelImageRecord
+from ..data.shard import Shard
+
+
+def read_mnist_idx(images_path: str, labels_path: str
+                   ) -> Iterator[Tuple[np.ndarray, int]]:
+    """Parse the MNIST idx format (big-endian headers)."""
+    with open(labels_path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise ValueError(f"{labels_path}: bad idx label magic {magic}")
+        labels = np.frombuffer(f.read(n), np.uint8)
+    with open(images_path, "rb") as f:
+        magic, n2, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise ValueError(f"{images_path}: bad idx image magic {magic}")
+        if n2 != n:
+            raise ValueError(f"image/label count mismatch: {n2} vs {n}")
+        for i in range(n):
+            img = np.frombuffer(f.read(rows * cols), np.uint8)
+            yield img.reshape(rows, cols), int(labels[i])
+
+
+def read_cifar10_bins(paths: List[str]) -> Iterator[Tuple[np.ndarray, int]]:
+    """CIFAR-10 binary batches: rows of [label u8][3072 pixel u8]."""
+    for path in paths:
+        with open(path, "rb") as f:
+            while True:
+                row = f.read(3073)
+                if len(row) < 3073:
+                    break
+                yield (np.frombuffer(row[1:], np.uint8).reshape(3, 32, 32),
+                       row[0])
+
+
+def create_shard(source: Iterator[Tuple[np.ndarray, int]], out_folder: str,
+                 append: bool = True) -> int:
+    """Write (image, label) pairs as Record tuples. Appending is
+    restartable: duplicate keys are skipped (data_loader.cc:122-143)."""
+    os.makedirs(out_folder, exist_ok=True)
+    mode = Shard.KAPPEND if append else Shard.KCREATE
+    n = 0
+    with Shard(out_folder, mode) as sh:
+        for i, (img, label) in enumerate(source):
+            rec = Record(image=SingleLabelImageRecord(
+                shape=list(img.shape), label=label, pixel=img.tobytes()))
+            if sh.insert(f"{i:08d}", rec.encode()):
+                n += 1
+    return n
+
+
+def split_shard(in_folder: str, out_prefix: str, n: int) -> List[int]:
+    """Round-robin split into n sub-shards (SplitN semantics)."""
+    outs = []
+    counts = []
+    for i in range(n):
+        folder = f"{out_prefix}{i}"
+        os.makedirs(folder, exist_ok=True)
+        outs.append(Shard(folder, Shard.KCREATE))
+        counts.append(0)
+    with Shard(in_folder, Shard.KREAD) as src:
+        for i, (key, val) in enumerate(src):
+            outs[i % n].insert(key, val)
+            counts[i % n] += 1
+    for sh in outs:
+        sh.close()
+    return counts
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print(__doc__)
+        return 2
+    cmd = argv[0]
+    if cmd == "create" and len(argv) >= 2 and argv[1] == "mnist":
+        images, labels, out = argv[2:5]
+        n = create_shard(read_mnist_idx(images, labels), out)
+        print(f"wrote {n} records to {out}")
+    elif cmd == "create" and len(argv) >= 2 and argv[1] == "cifar10":
+        *bins, out = argv[2:]
+        n = create_shard(read_cifar10_bins(bins), out)
+        print(f"wrote {n} records to {out}")
+    elif cmd == "split":
+        in_folder, out_prefix, n = argv[1], argv[2], int(argv[3])
+        counts = split_shard(in_folder, out_prefix, n)
+        print(f"split into {counts}")
+    else:
+        print(__doc__)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

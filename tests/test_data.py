@@ -105,3 +105,90 @@ def test_synthetic_learnable_batches():
     assert b["data"]["pixel"].shape == (8, 28, 28)
     assert b["data"]["pixel"].dtype == np.uint8
     assert b["data"]["label"].shape == (8,)
+
+
+def test_native_shard_interop(tmp_path):
+    """The C++ shard store and the Python one are byte-interoperable
+    (both follow the reference format, shard.cc)."""
+    native = pytest.importorskip("singa_tpu.data.native")
+    if not native.available():
+        pytest.skip("native library not built")
+    # write with C++, read with Python
+    with native.NativeShardWriter(str(tmp_path)) as w:
+        assert w.insert("a", b"alpha")
+        assert w.insert("b", b"beta")
+        assert not w.insert("a", b"dup")
+    with Shard(str(tmp_path), Shard.KREAD) as sh:
+        assert [(k, v) for k, v in sh] == [(b"a", b"alpha"), (b"b", b"beta")]
+    # append with C++ (dedup must survive), read with C++
+    with native.NativeShardWriter(str(tmp_path), append=True) as w:
+        assert not w.insert("b", b"dup")
+        assert w.insert("c", b"gamma")
+    with native.NativeShardReader(str(tmp_path)) as r:
+        assert r.count() == 3
+        assert [k for k, _ in r] == [b"a", b"b", b"c"]
+
+
+def test_native_shard_torn_tail(tmp_path):
+    native = pytest.importorskip("singa_tpu.data.native")
+    if not native.available():
+        pytest.skip("native library not built")
+    with native.NativeShardWriter(str(tmp_path)) as w:
+        w.insert("k1", b"v1")
+    with open(os.path.join(str(tmp_path), "shard.dat"), "ab") as f:
+        f.write(struct.pack("<Q", 2) + b"k2")   # torn record
+    with native.NativeShardWriter(str(tmp_path), append=True) as w:
+        assert w.insert("k3", b"v3")
+    with native.NativeShardReader(str(tmp_path)) as r:
+        assert [k for k, _ in r] == [b"k1", b"k3"]
+
+
+def test_loader_tool_mnist_and_split(tmp_path):
+    """tools/data_loader parity: idx -> shard -> split."""
+    import struct as st
+    from singa_tpu.tools import loader
+    # synthesize tiny idx files
+    n, r, c = 10, 4, 4
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, (n, r, c), dtype=np.uint8)
+    labels = rng.integers(0, 10, n).astype(np.uint8)
+    ip = tmp_path / "img.idx"
+    lp = tmp_path / "lab.idx"
+    ip.write_bytes(st.pack(">IIII", 2051, n, r, c) + imgs.tobytes())
+    lp.write_bytes(st.pack(">II", 2049, n) + labels.tobytes())
+
+    out = tmp_path / "shard"
+    wrote = loader.create_shard(loader.read_mnist_idx(str(ip), str(lp)),
+                                str(out))
+    assert wrote == n
+    # restartable: re-running appends nothing (key dedup)
+    wrote2 = loader.create_shard(loader.read_mnist_idx(str(ip), str(lp)),
+                                 str(out))
+    assert wrote2 == 0
+
+    with Shard(str(out), Shard.KREAD) as sh:
+        recs = [Record.decode(v) for _, v in sh]
+    assert len(recs) == n
+    np.testing.assert_array_equal(recs[3].image.pixels_array(), imgs[3])
+    assert recs[3].image.label == labels[3]
+
+    counts = loader.split_shard(str(out), str(tmp_path / "part"), 3)
+    assert sum(counts) == n and counts == [4, 3, 3]
+
+
+def test_loader_tool_cifar(tmp_path):
+    from singa_tpu.tools import loader
+    rng = np.random.default_rng(1)
+    rows = b"".join(
+        bytes([rng.integers(0, 10)]) + rng.integers(0, 256, 3072,
+                                                    dtype=np.uint8).tobytes()
+        for _ in range(5))
+    binp = tmp_path / "data_batch.bin"
+    binp.write_bytes(rows)
+    out = tmp_path / "shard"
+    wrote = loader.create_shard(loader.read_cifar10_bins([str(binp)]),
+                                str(out))
+    assert wrote == 5
+    with Shard(str(out), Shard.KREAD) as sh:
+        rec = Record.decode(next(iter(sh))[1])
+    assert rec.image.shape == [3, 32, 32]
