@@ -50,6 +50,15 @@ def main(argv=None) -> int:
     model = load_model_config(args.model_conf)
     cluster = (load_cluster_config(args.cluster_conf)
                if args.cluster_conf else None)
+
+    # Multi-host bootstrap BEFORE any jax device query: -procsID/-hostfile
+    # are the reference's launch coordinates (run.sh:20-37); here they
+    # seed jax.distributed so jax.devices() spans every host.
+    if args.hostfile:
+        from .parallel.bootstrap import DEFAULT_PORT, distributed_init
+        port = cluster.start_port if cluster else DEFAULT_PORT
+        if distributed_init(args.procsID, args.hostfile, port=port):
+            print(f"jax.distributed initialized: process {args.procsID}")
     if args.steps is not None:
         model.train_steps = args.steps
 

@@ -4,6 +4,8 @@ Sequence/pipeline strategies import lazily — they pull in Pallas and are
 only needed when a model actually uses them.
 """
 
+from .bootstrap import (coordinator_address, distributed_init,
+                        parse_hostfile)
 from .mesh import AXES, make_mesh, mesh_from_cluster
 from .partition import (param_shardings, batch_shardings,
                         seq_batch_shardings, shard_params,

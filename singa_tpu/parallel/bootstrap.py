@@ -1,0 +1,94 @@
+"""Multi-host bootstrap — TPU-native successor of the hostfile launch.
+
+Reference: the singa binary is launched once per process with
+`-procsID=$i -hostfile=<file>` (examples/mnist/run.sh:20-37); each
+process reads the hostfile to learn every peer's address and derives
+its role and ports from its id (cluster.cc:10-26, cluster.h:80-95).
+Bootstrap is static — no discovery, no elasticity.
+
+The TPU-native equivalent keeps the exact same launch surface
+(-procsID, -hostfile) but hands coordination to `jax.distributed`:
+the first hostfile line is the coordinator, `start_port` (the same
+ClusterProto field that anchored the reference's ZMQ port scheme)
+becomes the coordinator port, and every process calls
+`jax.distributed.initialize` over DCN.  After that, `jax.devices()`
+spans all hosts and a single Mesh covers the whole slice — the
+worker/server role fork (main.cc:49-55) is gone because gradient
+aggregation is a compiled psum, not a server plane.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+DEFAULT_PORT = 6723  # ClusterProto.start_port default (cluster.proto:7)
+
+
+def parse_hostfile(path: str) -> List[str]:
+    """One host per line, '#' comments and blank lines ignored
+    (reference hostfile format, examples/mnist/hostfile)."""
+    hosts: List[str] = []
+    with open(path) as f:
+        for line in f:
+            host = line.split("#", 1)[0].strip()
+            if host:
+                hosts.append(host)
+    return hosts
+
+
+def coordinator_address(hosts: List[str], port: int = DEFAULT_PORT) -> str:
+    """Coordinator = first hostfile entry (the reference pins server
+    processes to the tail of the id range instead; with no server plane
+    the head host simply hosts the rendezvous)."""
+    if not hosts:
+        raise ValueError("empty hostfile")
+    head = hosts[0]
+    if ":" in head:  # host:port spelling wins over start_port
+        return head
+    return f"{head}:{port}"
+
+
+def distributed_init(procs_id: int = 0,
+                     hostfile: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     port: int = DEFAULT_PORT) -> bool:
+    """Initialize jax.distributed from the reference launch coordinates.
+
+    Returns True if multi-process init ran, False for the single-process
+    fast path (hostfile absent / one host) — mirroring how a 1-line
+    hostfile run of the reference degenerates to a single process.
+
+    Environment overrides (JAX's own convention) win when set:
+    JAX_COORDINATOR_ADDRESS, JAX_NUM_PROCESSES, JAX_PROCESS_ID.
+    """
+    env_num = os.environ.get("JAX_NUM_PROCESSES")
+    env_pid = os.environ.get("JAX_PROCESS_ID")
+    if env_num is not None:
+        num_processes = int(env_num)
+    if env_pid is not None:
+        procs_id = int(env_pid)
+    if hostfile is None and num_processes is None:
+        return False
+    if hostfile is not None:
+        hosts = parse_hostfile(hostfile)
+        if num_processes is None:
+            num_processes = len(hosts)
+        coord = os.environ.get("JAX_COORDINATOR_ADDRESS") or \
+            coordinator_address(hosts, port)
+    else:
+        coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+        if coord is None:
+            raise ValueError(
+                "num_processes given without hostfile; set "
+                "JAX_COORDINATOR_ADDRESS or pass a hostfile")
+    if not 0 <= procs_id < num_processes:
+        raise ValueError(
+            f"procsID {procs_id} out of range for {num_processes} processes")
+    if num_processes == 1:
+        return False
+    import jax
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=num_processes,
+                               process_id=procs_id)
+    return True

@@ -121,3 +121,51 @@ def test_tp_sharded_step_matches_single_device():
     np.testing.assert_allclose(np.asarray(p1["ip1/weight"]),
                                np.asarray(p2["ip1/weight"]),
                                rtol=1e-4, atol=1e-5)
+
+
+# --- multi-host bootstrap (parallel/bootstrap.py) -------------------------
+
+def test_parse_hostfile_and_coordinator(tmp_path):
+    from singa_tpu.parallel import coordinator_address, parse_hostfile
+    hf = tmp_path / "hostfile"
+    hf.write_text("# cluster\nhost-a\n\nhost-b  # trailing\nhost-c:9999\n")
+    hosts = parse_hostfile(str(hf))
+    assert hosts == ["host-a", "host-b", "host-c:9999"]
+    assert coordinator_address(hosts, port=7001) == "host-a:7001"
+    # explicit host:port head wins over the port argument
+    assert coordinator_address(["h:5"], port=7001) == "h:5"
+
+
+def test_distributed_init_single_process_fast_path(tmp_path):
+    from singa_tpu.parallel import distributed_init
+    hf = tmp_path / "hostfile"
+    hf.write_text("localhost\n")
+    # one host → no multi-process init (and no jax.distributed side effect)
+    assert distributed_init(0, str(hf)) is False
+    assert distributed_init(0, None) is False
+
+
+def test_distributed_init_validates_procs_id(tmp_path):
+    from singa_tpu.parallel import distributed_init
+    hf = tmp_path / "hostfile"
+    hf.write_text("host-a\nhost-b\n")
+    with pytest.raises(ValueError):
+        distributed_init(5, str(hf))
+
+
+def test_distributed_init_out_of_range_even_single_host(tmp_path):
+    from singa_tpu.parallel import distributed_init
+    hf = tmp_path / "hostfile"
+    hf.write_text("localhost\n")
+    with pytest.raises(ValueError):
+        distributed_init(3, str(hf))  # stale/truncated hostfile: fail fast
+
+
+def test_distributed_init_env_overrides(tmp_path, monkeypatch):
+    from singa_tpu.parallel import distributed_init
+    hf = tmp_path / "hostfile"
+    hf.write_text("host-a\nhost-b\n")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "1")
+    monkeypatch.setenv("JAX_PROCESS_ID", "0")
+    # env says single process → fast path, even with a 2-host file
+    assert distributed_init(1, str(hf)) is False
