@@ -1,7 +1,10 @@
 """Benchmark: MNIST LeNet (reference examples/mnist/conv.conf) training
 throughput on the available accelerator.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line on stdout: {"metric", "value", "unit",
+"vs_baseline"}.  Secondary metrics (AlexNet/CIFAR-10 MFU — north-star
+gate 2 — and transformer-LM MFU) go to stderr so the driver contract
+stays a single stdout line.
 
 The reference publishes no numbers (README.md:1-5); BASELINE.md records
 its harness only.  `vs_baseline` is computed against REFERENCE_IMG_SEC,
@@ -13,6 +16,7 @@ scale its 2015-era CPU cluster sweep targeted).
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
@@ -23,7 +27,21 @@ WARMUP = 3
 ITERS = 20
 
 
-def main() -> None:
+def _time_steps(trainer, params, opt_state, batch, key, iters, warmup):
+    import jax
+    for step in range(warmup):
+        params, opt_state, _ = trainer.train_step(
+            params, opt_state, batch, step, key)
+    jax.block_until_ready(params)
+    t0 = time.perf_counter()
+    for step in range(warmup, warmup + iters):
+        params, opt_state, _ = trainer.train_step(
+            params, opt_state, batch, step, key)
+    jax.block_until_ready(params)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_lenet():
     import jax
 
     from singa_tpu.config import load_model_config
@@ -44,27 +62,92 @@ def main() -> None:
         "label": jax.device_put(
             rng.integers(0, 10, (BATCH,)).astype(np.int32)),
     }}
-    key = jax.random.PRNGKey(0)
-
-    for step in range(WARMUP):
-        params, opt_state, metrics = trainer.train_step(
-            params, opt_state, batch, step, key)
-    jax.block_until_ready(params)
-
-    t0 = time.perf_counter()
-    for step in range(WARMUP, WARMUP + ITERS):
-        params, opt_state, metrics = trainer.train_step(
-            params, opt_state, batch, step, key)
-    jax.block_until_ready(params)
-    dt = time.perf_counter() - t0
-
-    img_sec = BATCH * ITERS / dt
+    step_s = _time_steps(trainer, params, opt_state, batch,
+                         jax.random.PRNGKey(0), ITERS, WARMUP)
+    img_sec = BATCH / step_s
     print(json.dumps({
         "metric": "mnist_lenet_train_throughput",
         "value": round(img_sec, 1),
         "unit": "img/sec/chip",
         "vs_baseline": round(img_sec / REFERENCE_IMG_SEC, 2),
     }))
+
+
+def bench_alexnet_mfu(batch_size=256, precision="bfloat16"):
+    """North-star gate 2: AlexNet/CIFAR-10 at >=50% MFU (BASELINE.md)."""
+    import jax
+
+    from singa_tpu.core.trainer import Trainer
+    from singa_tpu.models.vision import alexnet_cifar10
+    from singa_tpu.utils.flops import mfu, net_train_flops
+
+    cfg = alexnet_cifar10(batchsize=batch_size)
+    cfg.precision = precision
+    shapes = {"data": {"pixel": (3, 32, 32), "label": ()}}
+    trainer = Trainer(cfg, shapes, log_fn=lambda s: None)
+    params, opt_state = trainer.init(seed=0)
+    rng = np.random.default_rng(0)
+    batch = {"data": {
+        "pixel": jax.device_put(
+            rng.standard_normal((batch_size, 3, 32, 32)).astype(np.float32)),
+        "label": jax.device_put(
+            rng.integers(0, 10, (batch_size,)).astype(np.int32)),
+    }}
+    step_s = _time_steps(trainer, params, opt_state, batch,
+                         jax.random.PRNGKey(0), ITERS, WARMUP)
+    flops = net_train_flops(trainer.train_net)
+    util = mfu(flops, step_s)
+    print(json.dumps({
+        "metric": "alexnet_cifar10_mfu", "value":
+            round(util, 4) if util is not None else None,
+        "unit": "fraction_of_peak", "img_sec": round(batch_size / step_s, 1),
+        "step_ms": round(step_s * 1e3, 3), "model_tflops_per_step":
+            round(flops / 1e12, 4), "precision": precision,
+    }), file=sys.stderr)
+
+
+def bench_transformer_mfu(batch_size=8, seq_len=1024, precision="bfloat16"):
+    import jax
+
+    from singa_tpu.core.trainer import Trainer
+    from singa_tpu.models.transformer import (synthetic_token_batches,
+                                              transformer_lm)
+    from singa_tpu.utils.flops import compiled_flops, mfu
+
+    cfg = transformer_lm(vocab_size=32768, num_layers=12, embed_dim=768,
+                         num_heads=12, head_dim=64, seq_len=seq_len,
+                         batchsize=batch_size)
+    cfg.precision = precision
+    trainer = Trainer(cfg, {"data": {"input": (seq_len,),
+                                     "target": (seq_len,)}},
+                      log_fn=lambda s: None)
+    params, opt_state = trainer.init(seed=0)
+    batch = next(synthetic_token_batches(batch_size, seq_len, 32768))
+    batch = jax.tree_util.tree_map(jax.device_put, batch)
+    key = jax.random.PRNGKey(0)
+    step_s = _time_steps(trainer, params, opt_state, batch, key,
+                         ITERS, WARMUP)
+    flops = compiled_flops(trainer.train_step, params, opt_state, batch,
+                           0, key)
+    util = mfu(flops, step_s) if flops else None
+    ntok = batch_size * seq_len
+    print(json.dumps({
+        "metric": "transformer_lm_mfu", "value":
+            round(util, 4) if util is not None else None,
+        "unit": "fraction_of_peak", "tok_sec": round(ntok / step_s, 1),
+        "step_ms": round(step_s * 1e3, 3), "precision": precision,
+    }), file=sys.stderr)
+
+
+def main() -> None:
+    bench_lenet()
+    if "--extra" in sys.argv:
+        for fn in (bench_alexnet_mfu, bench_transformer_mfu):
+            try:
+                fn()
+            except Exception as e:  # secondary metrics must not break the
+                print(json.dumps({"metric": fn.__name__,  # driver contract
+                                  "error": repr(e)}), file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Benchmark sweep — the successor of the reference's examples/mnist/batch.sh
+# (nworkers x nservers x nthreads grid): here the grid is batch size x
+# precision on the visible accelerator. One JSON line per run is appended
+# to sweep.jsonl (primary metric from bench.py stdout; MFU extras on
+# stderr go to sweep.log).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+out=${1:-sweep.jsonl}
+: > "$out"
+for batch in 128 256 512 1024; do
+  echo "== batch=$batch ==" >&2
+  BENCH_BATCH=$batch python - >> "$out" 2>> sweep.log <<EOF
+import bench
+bench.BATCH = $batch
+bench.main()
+EOF
+done
+echo "wrote $out"

@@ -494,6 +494,10 @@ class BridgeDstLayer(BridgeSrcLayer):
 
 def create_layer(cfg: LayerConfig) -> Layer:
     if cfg.type not in LAYER_REGISTRY:
+        # the sequence family registers on import and is kept lazy
+        # (it pulls in Pallas); load it on first unknown type
+        from . import seq_layers  # noqa: F401
+    if cfg.type not in LAYER_REGISTRY:
         raise LayerError(f"unknown layer type {cfg.type!r} "
                          f"(registered: {sorted(LAYER_REGISTRY)})")
     return LAYER_REGISTRY[cfg.type](cfg)

@@ -18,7 +18,19 @@ def resolve_data_source(model_cfg, batchsize: int, seed: int = 0,
     folders from DataProto.path when they exist locally, else synthetic."""
     train_path = test_path = None
     train_name = test_name = "data"
-    for layer in (model_cfg.neuralnet.layer if model_cfg.neuralnet else []):
+    layers = model_cfg.neuralnet.layer if model_cfg.neuralnet else []
+
+    # token-sequence models (kSequenceData): synthetic Markov LM data
+    for layer in layers:
+        if layer.type == "kSequenceData" and layer.seqdata_param:
+            from ..models.transformer import synthetic_token_batches
+            p = layer.seqdata_param
+            mk = lambda s: synthetic_token_batches(  # noqa: E731
+                batchsize, p.seq_len, p.vocab_size, seed=s,
+                data_layer=layer.name)
+            return mk(seed), (lambda: mk(seed + 1))
+
+    for layer in layers:
         if layer.type in ("kShardData", "kLMDBData") and layer.data_param:
             if "kTrain" not in layer.exclude:
                 train_path, train_name = layer.data_param.path, layer.name

@@ -68,9 +68,28 @@ def main(argv=None) -> int:
         if layer.type in ("kShardData", "kLMDBData"):
             input_shapes.setdefault(
                 layer.name, {"pixel": (28, 28), "label": ()})
+        elif layer.type == "kSequenceData" and layer.seqdata_param:
+            s = layer.seqdata_param.seq_len
+            input_shapes.setdefault(
+                layer.name, {"input": (s,), "target": (s,)})
 
-    trainer = Trainer(model, input_shapes)
+    # Mesh from the cluster config: engages DP/TP/SP/EP shardings when
+    # more than one device is visible (ClusterProto topology → Mesh,
+    # the reference's Cluster singleton role, cluster.h:20-121).
+    import jax
+    mesh = None
+    if cluster is not None and len(jax.devices()) > 1:
+        from .parallel import mesh_from_cluster
+        ptype = model.neuralnet.partition_type if model.neuralnet else "kNone"
+        mesh = mesh_from_cluster(cluster, ptype)
+        print(f"mesh: {dict(mesh.shape)} over {len(jax.devices())} devices")
+
+    trainer = Trainer(model, input_shapes, mesh=mesh)
     params, opt_state = trainer.init(seed=args.seed)
+    if mesh is not None:
+        from .parallel import shard_opt_state, shard_params
+        params = shard_params(mesh, trainer.train_net, params)
+        opt_state = shard_opt_state(mesh, trainer.train_net, opt_state)
 
     workspace = args.workspace or (cluster.workspace if cluster else None)
     # an explicit --workspace is a request to checkpoint: default to a
@@ -94,15 +113,39 @@ def main(argv=None) -> int:
 
     train_layer = next(
         (l for l in model.neuralnet.layer
-         if l.type in ("kShardData", "kLMDBData") and "kTrain" not in l.exclude),
+         if l.type in ("kShardData", "kLMDBData", "kSequenceData")
+         and "kTrain" not in l.exclude),
         None)
-    bs = train_layer.data_param.batchsize if train_layer else 64
+    if train_layer is None:
+        bs = 64
+    elif train_layer.type == "kSequenceData":
+        bs = (train_layer.seqdata_param.batchsize
+              if train_layer.seqdata_param else 64)
+    else:
+        bs = train_layer.data_param.batchsize
 
     # Data source: shard files if the configured path exists locally,
     # else the synthetic source (reference configs point at dead hosts).
     from .data import resolve_data_source
     train_iter, test_factory = resolve_data_source(
         model, bs, seed=args.seed, force_synthetic=args.synthetic)
+
+    if mesh is not None:
+        from .parallel import (batch_shardings, seq_batch_shardings,
+                               shard_batch)
+        uses_sp = any(
+            l.attention_param and l.attention_param.seq_parallel != "none"
+            for l in model.neuralnet.layer)
+        shard_fn = seq_batch_shardings if uses_sp else batch_shardings
+
+        def _sharded(it):
+            for b in it:
+                yield shard_batch(mesh, b, shardings_fn=shard_fn)
+
+        train_iter = _sharded(train_iter)
+        if test_factory is not None:
+            inner_factory = test_factory
+            test_factory = lambda: _sharded(inner_factory())  # noqa: E731
 
     params, opt_state, history = trainer.run(
         params, opt_state, train_iter, test_iter_factory=test_factory,

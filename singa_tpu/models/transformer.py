@@ -105,14 +105,17 @@ def transformer_lm(vocab_size: int = 32000,
 
 
 def synthetic_token_batches(batchsize: int, seq_len: int, vocab_size: int,
-                            seed: int = 0, data_layer: str = "data"):
-    """Learnable synthetic LM data: order-2 Markov chains with a fixed
-    random transition table — a model that learns beats the unigram
-    entropy floor."""
+                            seed: int = 0, data_layer: str = "data",
+                            table_seed: int = 1234):
+    """Learnable synthetic LM data: Markov chains with a fixed random
+    transition table — a model that learns beats the unigram entropy
+    floor.  The table comes from `table_seed`, NOT `seed`, so train and
+    test streams (different seeds) sample the same "language"."""
     import numpy as np
     rng = np.random.default_rng(seed)
     # sparse-ish transition: each (prev) maps to 4 likely next tokens
-    nexts = rng.integers(0, vocab_size, (vocab_size, 4))
+    nexts = np.random.default_rng(table_seed).integers(
+        0, vocab_size, (vocab_size, 4))
     while True:
         toks = np.empty((batchsize, seq_len + 1), np.int32)
         toks[:, 0] = rng.integers(0, vocab_size, batchsize)

@@ -85,6 +85,10 @@ def shard_opt_state(mesh: Mesh, net: NeuralNet, opt_state,
     return {k: put_tree(v) for k, v in opt_state.items()}
 
 
-def shard_batch(mesh: Mesh, batch, data_axis: str = "data"):
-    shardings = batch_shardings(mesh, batch, data_axis)
+def shard_batch(mesh: Mesh, batch, data_axis: str = "data",
+                shardings_fn=None):
+    """device_put a host batch tree onto the mesh.  `shardings_fn`
+    defaults to batch_shardings; pass seq_batch_shardings for
+    sequence-parallel token layouts."""
+    shardings = (shardings_fn or batch_shardings)(mesh, batch, data_axis)
     return jax.tree_util.tree_map(jax.device_put, batch, shardings)

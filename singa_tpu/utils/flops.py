@@ -1,0 +1,98 @@
+"""FLOPs accounting and MFU (model FLOPs utilization).
+
+The reference reports only wall-clock phase percentages (TimerInfo,
+worker.h:91-114).  On TPU the north-star metric is MFU — achieved
+model FLOPs/s over the chip's peak (BASELINE.md: AlexNet/CIFAR-10 at
+>=50% MFU) — so this module adds two FLOPs sources:
+
+  * `compiled_flops(jitted, *args)` — XLA's own cost analysis of the
+    compiled program (exact for what actually runs, includes fusion).
+  * `net_forward_flops(net)` — analytic MXU-op count (2·MACs) walked
+    over the net's conv/linear layers; the test oracle for the above
+    and a device-independent estimate.
+
+MFU convention: model FLOPs (matmul/conv only, 2·MACs; backward
+counted as 2x forward, so train step = 3x forward) divided by
+(step_time · peak_flops).  Peak table is bf16 MXU peak per chip.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+# bf16 MXU peak FLOP/s per chip, by jax Device.device_kind.
+PEAK_FLOPS: Dict[str, float] = {
+    "TPU v2": 22.5e12, "TPU v3": 61.5e12 / 2,   # per chip (2 cores)
+    "TPU v4": 275e12, "TPU v4 lite": 137e12,
+    "TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v5": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12, "TPU v6e": 918e12,
+}
+
+
+def peak_flops(device=None) -> Optional[float]:
+    """Per-chip bf16 peak for `device` (default: jax.devices()[0]);
+    None when unknown (e.g. the CPU test platform)."""
+    if device is None:
+        import jax
+        device = jax.devices()[0]
+    return PEAK_FLOPS.get(getattr(device, "device_kind", ""))
+
+
+def compiled_flops(jitted, *args, **kwargs) -> Optional[float]:
+    """FLOPs of the compiled XLA program for `jitted(*args)`.
+
+    `jitted` must be a jax.jit-wrapped callable.  Returns None when the
+    backend's cost model does not report flops.
+    """
+    compiled = jitted.lower(*args, **kwargs).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):  # older jax returns [dict]
+        ca = ca[0] if ca else {}
+    flops = (ca or {}).get("flops")
+    return float(flops) if flops and flops > 0 else None
+
+
+def mfu(model_flops: float, step_seconds: float,
+        device=None) -> Optional[float]:
+    """model_flops per step / (step_seconds · peak). None when peak
+    unknown."""
+    peak = peak_flops(device)
+    if not peak or step_seconds <= 0:
+        return None
+    return model_flops / (step_seconds * peak)
+
+
+# -- analytic per-layer counts (forward, 2·MACs convention) ----------------
+
+def _conv_flops(layer) -> int:
+    n, c_out, h, w = layer.out_shape
+    return 2 * n * c_out * h * w * layer.kernel ** 2 * layer.channels
+
+
+def _linear_flops(layer) -> int:
+    n, out = layer.out_shape
+    vdim, hdim = layer.param_specs[0].shape  # weight (vdim, hdim)
+    return 2 * n * vdim * hdim
+
+
+def layer_forward_flops(layer) -> int:
+    """Matmul/conv FLOPs of one layer's forward; 0 for non-MXU layers
+    (elementwise/pool/LRN are bandwidth-, not FLOP-, dominated)."""
+    t = layer.cfg.type
+    if t == "kConvolution":
+        return _conv_flops(layer)
+    if t == "kInnerProduct":
+        return _linear_flops(layer)
+    return 0
+
+
+def net_forward_flops(net) -> int:
+    """Analytic forward model-FLOPs of a built NeuralNet."""
+    return sum(layer_forward_flops(net.layers[name]) for name in net.topo)
+
+
+def net_train_flops(net) -> int:
+    """Train-step model FLOPs: backward re-does each matmul twice
+    (d-input + d-weight), so 3x forward — the standard convention."""
+    return 3 * net_forward_flops(net)

@@ -192,3 +192,16 @@ def test_loader_tool_cifar(tmp_path):
     with Shard(str(out), Shard.KREAD) as sh:
         rec = Record.decode(next(iter(sh))[1])
     assert rec.image.shape == [3, 32, 32]
+
+
+def test_token_streams_share_language_across_seeds():
+    # train (seed) and test (seed+1) must sample the SAME transition
+    # table, else eval can never reflect learning
+    from singa_tpu.models.transformer import synthetic_token_batches
+    table = np.random.default_rng(1234).integers(0, 64, (64, 4))
+    for seed in (0, 1):
+        b = next(synthetic_token_batches(8, 128, 64, seed=seed))["data"]
+        inp, tgt = b["input"], b["target"]
+        hits = np.mean([tgt[i, t] in table[inp[i, t]]
+                        for i in range(8) for t in range(128)])
+        assert hits > 0.8, f"seed {seed}: only {hits:.2f} follow the table"
