@@ -23,21 +23,25 @@ import numpy as np
 
 REFERENCE_IMG_SEC = 1000.0
 BATCH = 512
-WARMUP = 3
-ITERS = 20
+ITERS = 50
 
 
-def _time_steps(trainer, params, opt_state, batch, key, iters, warmup):
-    import jax
-    for step in range(warmup):
-        params, opt_state, _ = trainer.train_step(
-            params, opt_state, batch, step, key)
-    jax.block_until_ready(params)
+def _time_steps(trainer, params, opt_state, batch, key, iters):
+    # NOTE: sync via host fetch (hard_sync), NOT jax.block_until_ready —
+    # the tunneled axon platform can return from block_until_ready before
+    # execution finishes, which yields impossible (>100% MFU) timings.
+    # Per-dispatch tunnel overhead is ~1ms, comparable to a small-model
+    # step, so all `iters` steps run as ONE compiled lax.scan program
+    # (trainer.train_steps) — device-only inner loop, one dispatch.
+    from singa_tpu.utils.profiler import hard_sync
+    # warmup = one full scan call: compiles the nsteps program and runs it
+    params, opt_state, _ = trainer.train_steps(
+        params, opt_state, batch, 0, key, iters)
+    hard_sync(params)
     t0 = time.perf_counter()
-    for step in range(warmup, warmup + iters):
-        params, opt_state, _ = trainer.train_step(
-            params, opt_state, batch, step, key)
-    jax.block_until_ready(params)
+    params, opt_state, _ = trainer.train_steps(
+        params, opt_state, batch, iters, key, iters)
+    hard_sync(params)
     return (time.perf_counter() - t0) / iters
 
 
@@ -63,7 +67,7 @@ def bench_lenet():
             rng.integers(0, 10, (BATCH,)).astype(np.int32)),
     }}
     step_s = _time_steps(trainer, params, opt_state, batch,
-                         jax.random.PRNGKey(0), ITERS, WARMUP)
+                         jax.random.PRNGKey(0), ITERS)
     img_sec = BATCH / step_s
     print(json.dumps({
         "metric": "mnist_lenet_train_throughput",
@@ -73,37 +77,47 @@ def bench_lenet():
     }))
 
 
-def bench_alexnet_mfu(batch_size=256, precision="bfloat16"):
-    """North-star gate 2: AlexNet/CIFAR-10 at >=50% MFU (BASELINE.md)."""
+def bench_alexnet_mfu(batch_size=1024, precision="bfloat16"):
+    """North-star gate 2: AlexNet/CIFAR-10 at >=50% MFU (BASELINE.md).
+
+    Measured on the actual 5-conv AlexNet stack adapted to 32x32
+    (models.vision.alexnet_cifar10_full); the 3-conv caffe quick net is
+    reported alongside as cifar10_quick (its 32-channel convs cap the
+    128-lane MXU well below the gate regardless of software quality).
+    """
     import jax
 
     from singa_tpu.core.trainer import Trainer
-    from singa_tpu.models.vision import alexnet_cifar10
+    from singa_tpu.models.vision import alexnet_cifar10, alexnet_cifar10_full
     from singa_tpu.utils.flops import mfu, net_train_flops
 
-    cfg = alexnet_cifar10(batchsize=batch_size)
-    cfg.precision = precision
     shapes = {"data": {"pixel": (3, 32, 32), "label": ()}}
-    trainer = Trainer(cfg, shapes, log_fn=lambda s: None)
-    params, opt_state = trainer.init(seed=0)
     rng = np.random.default_rng(0)
-    batch = {"data": {
-        "pixel": jax.device_put(
-            rng.standard_normal((batch_size, 3, 32, 32)).astype(np.float32)),
-        "label": jax.device_put(
-            rng.integers(0, 10, (batch_size,)).astype(np.int32)),
-    }}
-    step_s = _time_steps(trainer, params, opt_state, batch,
-                         jax.random.PRNGKey(0), ITERS, WARMUP)
-    flops = net_train_flops(trainer.train_net)
-    util = mfu(flops, step_s)
-    print(json.dumps({
-        "metric": "alexnet_cifar10_mfu", "value":
-            round(util, 4) if util is not None else None,
-        "unit": "fraction_of_peak", "img_sec": round(batch_size / step_s, 1),
-        "step_ms": round(step_s * 1e3, 3), "model_tflops_per_step":
-            round(flops / 1e12, 4), "precision": precision,
-    }), file=sys.stderr)
+    for metric, cfg, bs, iters in (
+            ("alexnet_cifar10_mfu", alexnet_cifar10_full(batchsize=batch_size),
+             batch_size, 20),
+            ("cifar10_quick_mfu", alexnet_cifar10(batchsize=batch_size),
+             batch_size, ITERS)):
+        cfg.precision = precision
+        trainer = Trainer(cfg, shapes, log_fn=lambda s: None)
+        params, opt_state = trainer.init(seed=0)
+        batch = {"data": {
+            "pixel": jax.device_put(
+                rng.standard_normal((bs, 3, 32, 32)).astype(np.float32)),
+            "label": jax.device_put(
+                rng.integers(0, 10, (bs,)).astype(np.int32)),
+        }}
+        step_s = _time_steps(trainer, params, opt_state, batch,
+                             jax.random.PRNGKey(0), iters)
+        flops = net_train_flops(trainer.train_net)
+        util = mfu(flops, step_s)
+        print(json.dumps({
+            "metric": metric, "value":
+                round(util, 4) if util is not None else None,
+            "unit": "fraction_of_peak", "img_sec": round(bs / step_s, 1),
+            "step_ms": round(step_s * 1e3, 3), "model_tflops_per_step":
+                round(flops / 1e12, 4), "precision": precision,
+        }), file=sys.stderr)
 
 
 def bench_transformer_mfu(batch_size=8, seq_len=1024, precision="bfloat16"):
@@ -126,7 +140,7 @@ def bench_transformer_mfu(batch_size=8, seq_len=1024, precision="bfloat16"):
     batch = jax.tree_util.tree_map(jax.device_put, batch)
     key = jax.random.PRNGKey(0)
     step_s = _time_steps(trainer, params, opt_state, batch, key,
-                         ITERS, WARMUP)
+                         ITERS)
     flops = compiled_flops(trainer.train_step, params, opt_state, batch,
                            0, key)
     util = mfu(flops, step_s) if flops else None

@@ -10,7 +10,9 @@ out=${1:-sweep.jsonl}
 : > "$out"
 for batch in 128 256 512 1024; do
   echo "== batch=$batch ==" >&2
-  BENCH_BATCH=$batch python - >> "$out" 2>> sweep.log <<EOF
+  python - >> "$out" 2>> sweep.log <<EOF
+import sys
+sys.argv.append("--extra")
 import bench
 bench.BATCH = $batch
 bench.main()

@@ -126,6 +126,52 @@ class Trainer:
         donate_args = (0, 1) if donate else ()
         self.train_step = jax.jit(train_step, donate_argnums=donate_args)
 
+        def train_scan(params, opt_state, batches, start_step, rng, nsteps,
+                       stacked=False):
+            """`nsteps` training steps in ONE compiled program (lax.scan).
+
+            Removes the per-step host dispatch from the inner loop — the
+            TPU analogue of the reference keeping its hot loop inside the
+            Executor thread (worker.cc:98-106) instead of crossing a
+            process boundary per batch.  With `stacked=True` every leaf
+            of `batches` carries a leading `nsteps` axis that is scanned
+            over (a fresh batch per step); with the default False,
+            `batches` is a single batch reused every step.  Returns
+            stacked per-step metrics.
+            """
+            def body(carry, xs):
+                p, o = carry
+                step, batch = xs
+                if batch is None:
+                    batch = batches
+                step_rng = jax.random.fold_in(rng, step)
+
+                def loss_fn(pp):
+                    loss, metrics, _ = net.apply(
+                        pp, batch, rng=step_rng, train=True, mesh=mesh,
+                        compute_dtype=cdtype)
+                    return loss, metrics
+                (_, metrics), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(p)
+                p, o = updater.update(step, grads, p, o, multipliers=mults)
+                return (p, o), metrics
+
+            steps = start_step + jnp.arange(nsteps)
+            if stacked:
+                bad = [x.shape for x in jax.tree_util.tree_leaves(batches)
+                       if getattr(x, "ndim", 0) < 1 or x.shape[0] != nsteps]
+                if bad:
+                    raise ValueError(
+                        f"stacked=True needs a leading {nsteps}-axis on "
+                        f"every batch leaf; got shapes {bad}")
+            xs = (steps, batches if stacked else None)
+            (params, opt_state), metrics = jax.lax.scan(
+                body, (params, opt_state), xs, length=nsteps)
+            return params, opt_state, metrics
+
+        self.train_steps = jax.jit(train_scan, static_argnums=(5, 6),
+                                   donate_argnums=donate_args)
+
         def make_eval(net):
             def eval_step(params, batch):
                 _, metrics, _ = net.apply(params, batch, train=False,

@@ -1,3 +1,3 @@
-from .vision import (alexnet_cifar10, alexnet_imagenet, lenet_mnist,
-                     mlp_mnist)
+from .vision import (alexnet_cifar10, alexnet_cifar10_full, alexnet_imagenet,
+                     lenet_mnist, mlp_mnist)
 from . import rbm

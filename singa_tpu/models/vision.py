@@ -132,6 +132,52 @@ def alexnet_cifar10(batchsize: int = 128, train_steps: int = 10000,
     })
 
 
+def alexnet_cifar10_full(batchsize: int = 1024, train_steps: int = 50000,
+                         lr: float = 0.01) -> ModelConfig:
+    """The actual 5-conv AlexNet stack (conv1-5 + LRN×2 + fc6-8) adapted
+    to 32×32 CIFAR-10 input (stride-1 conv1, as in the standard CIFAR
+    adaptation).  This — not the 3-conv caffe 'cifar10_quick' above — is
+    the 'AlexNet on CIFAR-10' of the BASELINE MFU gate; its 192-384
+    channel convs and 4096-wide fcs are MXU-shaped, whereas the quick
+    net's 32-channel convs cap out the 128-lane MXU at ~25%."""
+    layers, head = _data_head(batchsize, "kRGBImage", rgb_scale=1 / 255.0)
+    layers += [
+        _conv("conv1", head, 64, 5, 1, 2, std=1e-2),
+        _relu("relu1", "conv1"),
+        _lrn("norm1", "relu1", 5, 1e-4),
+        _pool("pool1", "norm1", 3, 2),
+        _conv("conv2", "pool1", 192, 5, 1, 2, std=1e-2, bias_value=1.0),
+        _relu("relu2", "conv2"),
+        _lrn("norm2", "relu2", 5, 1e-4),
+        _pool("pool2", "norm2", 3, 2),
+        _conv("conv3", "pool2", 384, 3, 1, 1, std=1e-2),
+        _relu("relu3", "conv3"),
+        _conv("conv4", "relu3", 256, 3, 1, 1, std=1e-2, bias_value=1.0),
+        _relu("relu4", "conv4"),
+        _conv("conv5", "relu4", 256, 3, 1, 1, std=1e-2, bias_value=1.0),
+        _relu("relu5", "conv5"),
+        _pool("pool5", "relu5", 3, 2),
+        _ip("fc6", "pool5", 4096, std=5e-3, bias_value=1.0),
+        _relu("relu6", "fc6"),
+        _dropout("drop6", "relu6"),
+        _ip("fc7", "drop6", 4096, std=5e-3, bias_value=1.0),
+        _relu("relu7", "fc7"),
+        _dropout("drop7", "relu7"),
+        _ip("fc8", "drop7", 10, std=1e-2),
+        _loss("fc8"),
+    ]
+    return model_config_from_dict({
+        "name": "alexnet-cifar10-full",
+        "train_steps": train_steps,
+        "display_frequency": 100,
+        "updater": {"type": "kSGD", "base_learning_rate": lr,
+                    "momentum": 0.9, "weight_decay": 0.0005,
+                    "learning_rate_change_method": "kStep", "gamma": 0.1,
+                    "learning_rate_change_frequency": 20000},
+        "neuralnet": {"layer": layers},
+    })
+
+
 def alexnet_imagenet(batchsize: int = 256, train_steps: int = 450000,
                      nclass: int = 1000) -> ModelConfig:
     """Full AlexNet (ImageNet-1k, single-tower): the reference BASELINE's

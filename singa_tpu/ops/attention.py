@@ -135,6 +135,19 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     return out.reshape(b, h, sq, d)
 
 
+def _on_tpu() -> bool:
+    """True when the default device is TPU hardware.  Checks device_kind
+    as well as platform because tunneled TPU backends (e.g. the `axon`
+    platform) report a platform name that isn't "tpu" while still
+    compiling Pallas TPU kernels."""
+    try:
+        dev = jax.devices()[0]
+    except Exception:
+        return False
+    return ("tpu" in getattr(dev, "platform", "").lower()
+            or "TPU" in getattr(dev, "device_kind", ""))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
                     block_k: int = 128, interpret: Optional[bool] = None):
@@ -142,7 +155,7 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
     interpret=True) the Pallas kernel runs interpreted; backward is
     blockwise rematerialization."""
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = not _on_tpu()
     return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
 
 

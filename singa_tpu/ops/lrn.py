@@ -29,4 +29,10 @@ def lrn(x: jnp.ndarray, local_size: int = 5, alpha: float = 1.0,
         window_strides=(1, 1, 1, 1),
         padding=((0, 0), (half, half), (0, 0), (0, 0)))
     norm = norm * (alpha / local_size) + knorm
+    if beta == 0.75:
+        # norm^-3/4 == rsqrt(norm)*sqrt(rsqrt(norm)): sqrt/rsqrt are
+        # single VPU ops, vs pow = exp∘log transcendentals which
+        # measured as expensive as the windowed sum itself.
+        r = lax.rsqrt(norm)
+        return x * (r * jnp.sqrt(r))
     return x * (norm ** -beta)

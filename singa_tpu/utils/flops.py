@@ -20,9 +20,11 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
-# bf16 MXU peak FLOP/s per chip, by jax Device.device_kind.
+# bf16 MXU peak FLOP/s per jax Device, keyed by device_kind.  v2/v3
+# expose one device per core (chip peaks are 45/123 TFLOP/s over 2
+# cores); v4+ expose one device per chip.
 PEAK_FLOPS: Dict[str, float] = {
-    "TPU v2": 22.5e12, "TPU v3": 61.5e12 / 2,   # per chip (2 cores)
+    "TPU v2": 22.5e12, "TPU v3": 61.5e12,
     "TPU v4": 275e12, "TPU v4 lite": 137e12,
     "TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v5": 197e12,
     "TPU v5p": 459e12,

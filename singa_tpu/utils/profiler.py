@@ -18,6 +18,23 @@ from typing import Iterator, Optional
 import jax
 
 
+def hard_sync(tree) -> None:
+    """Force completion of all pending device work feeding `tree`.
+
+    `jax.block_until_ready` is the documented barrier, but experimental
+    transport backends (e.g. the tunneled `axon` platform) can return
+    from it before execution finishes, which silently corrupts wall-clock
+    timing (we observed impossible >200% MFU).  Fetching bytes to the
+    host cannot complete early, so timing code must use this instead.
+    """
+    import numpy as np
+    leaves = jax.tree_util.tree_leaves(tree)
+    for leaf in leaves:
+        if hasattr(leaf, "addressable_shards") or hasattr(leaf, "device"):
+            np.asarray(jax.device_get(leaf.ravel()[:1] if leaf.ndim else leaf))
+            break
+
+
 @contextlib.contextmanager
 def trace(logdir: str) -> Iterator[None]:
     """Capture a jax.profiler trace viewable in TensorBoard/XProf."""

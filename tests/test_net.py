@@ -214,3 +214,44 @@ def test_debug_info_and_json():
     assert "conv1" in info and "param" in info
     j = net.to_json()
     assert '"nodes"' in j and '"links"' in j
+
+
+def test_train_steps_scan_matches_per_step_calls():
+    """trainer.train_steps (one lax.scan program) must reproduce n
+    individual train_step calls exactly — same params, same metrics."""
+    cfg = load_model_config("/root/reference/examples/mnist/conv.conf")
+    cfg.display_frequency = 0
+    trainer = Trainer(cfg, MNIST_SHAPES, donate=False)
+    params, opt_state = trainer.init(seed=0)
+    rng = np.random.default_rng(7)
+    key = jax.random.PRNGKey(9)
+    n = 4
+
+    # reused fixed batch
+    batch = _mnist_batch(8, rng)
+    p_scan, o_scan, metrics = trainer.train_steps(
+        params, opt_state, batch, 0, key, n)
+    assert metrics["loss"].shape == (n,)
+    p_ref, o_ref = params, opt_state
+    for step in range(n):
+        p_ref, o_ref, m = trainer.train_step(
+            p_ref, o_ref, batch, step, jax.random.fold_in(key, step))
+        np.testing.assert_allclose(float(metrics["loss"][step]),
+                                   float(m["loss"]), rtol=1e-5)
+    for k in p_ref:
+        np.testing.assert_allclose(np.asarray(p_scan[k]),
+                                   np.asarray(p_ref[k]), atol=1e-5)
+
+    # stacked per-step batches (leading axis n) are scanned over
+    batches = [_mnist_batch(8, rng) for _ in range(n)]
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *batches)
+    p_scan2, _, metrics2 = trainer.train_steps(
+        params, opt_state, stacked, 0, key, n, True)
+    p_ref2, o_ref2 = params, opt_state
+    for step in range(n):
+        p_ref2, o_ref2, m = trainer.train_step(
+            p_ref2, o_ref2, batches[step], step,
+            jax.random.fold_in(key, step))
+        np.testing.assert_allclose(float(metrics2["loss"][step]),
+                                   float(m["loss"]), rtol=1e-5)
