@@ -214,24 +214,63 @@ class Trainer:
             perf.update(jax.device_get(step_fn(params, batch)))
         return perf.averages()
 
+    def _next_chunk_len(self, step: int, scan_chunk: int) -> int:
+        """Longest chunk [step, step+n) that crosses no test/validate/
+        checkpoint boundary (those must run on the host between compiled
+        chunks); display steps may fall inside a chunk because their
+        metrics come back stacked."""
+        n = min(scan_chunk, self.cfg.train_steps - step)
+
+        def next_event(freq, after):
+            # smallest multiple of freq that is > step and >= after
+            if freq <= 0:
+                return None
+            m = (step // freq + 1) * freq
+            if m < after:
+                m = -(-after // freq) * freq
+            return m
+
+        for freq, after in ((self.cfg.test_frequency,
+                             self.cfg.test_after_steps),
+                            (self.cfg.validation_frequency,
+                             self.cfg.validation_after_steps)):
+            e = next_event(freq, after)
+            if e is not None:
+                n = min(n, e - step)
+        f = self.cfg.checkpoint_frequency
+        if f > 0:
+            # saves fire after steps s with (s+1) % f == 0; a chunk may
+            # end on such a step but not run past it
+            s_ck = ((step + 1 + f - 1) // f) * f - 1
+            n = min(n, s_ck - step + 1)
+        return max(n, 1)
+
     def run(self, params, opt_state,
             train_iter: Iterator,
             test_iter_factory: Optional[Callable[[], Iterator]] = None,
             val_iter_factory: Optional[Callable[[], Iterator]] = None,
             start_step: int = 0, seed: int = 0,
             hooks: Optional[List[Callable[[int, Dict], None]]] = None,
-            workspace: Optional[str] = None):
+            workspace: Optional[str] = None, scan_chunk: int = 0):
         """The Worker::Run loop (worker.cc:98-106).  With `workspace`,
         checkpoints {params, opt_state, step} at checkpoint_frequency and
         on completion (the resume path the reference left as a TODO,
-        worker.cc:65-67)."""
+        worker.cc:65-67).
+
+        `scan_chunk > 1` runs up to that many steps per device dispatch
+        via the fused lax.scan program (train_steps): batches are
+        prefetched and stacked on the host, the device runs the whole
+        chunk without host round-trips, and cadence events (test/
+        validate/checkpoint/display) still fire at exactly the reference
+        steps because chunks are cut at their boundaries."""
         ckpt = None
         if workspace and self.cfg.checkpoint_frequency > 0:
             from ..utils.checkpoint import CheckpointManager
             ckpt = CheckpointManager(workspace)
         rng = jax.random.PRNGKey(seed ^ 0x5eed)
         history: List[Dict[str, float]] = []
-        for step in range(start_step, self.cfg.train_steps):
+        step = start_step
+        while step < self.cfg.train_steps:
             if self.val_step and self.validate_now(step) and val_iter_factory:
                 avg = self.evaluate(params, val_iter_factory(),
                                     self.cfg.validation_steps, self.val_step)
@@ -244,29 +283,47 @@ class Trainer:
                     f"{k} : {v:.6f}" for k, v in sorted(avg.items())))
                 history.append({"step": step, **avg})
 
+            n = (self._next_chunk_len(step, scan_chunk)
+                 if scan_chunk and scan_chunk > 1 else 1)
             t0 = time.perf_counter()
-            batch = next(train_iter)
-            t1 = time.perf_counter()
-            step_rng = jax.random.fold_in(rng, step)
-            params, opt_state, metrics = self.train_step(
-                params, opt_state, batch, step, step_rng)
-            metrics = jax.device_get(metrics)
+            if n == 1:
+                batch = next(train_iter)
+                t1 = time.perf_counter()
+                params, opt_state, metrics = self.train_step(
+                    params, opt_state, batch, step,
+                    jax.random.fold_in(rng, step))
+                per_step = [jax.device_get(metrics)]
+            else:
+                batches = [next(train_iter) for _ in range(n)]
+                stacked = jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+                    *batches)
+                t1 = time.perf_counter()
+                params, opt_state, metrics = self.train_steps(
+                    params, opt_state, stacked, step, rng, n, True)
+                md = jax.device_get(metrics)
+                per_step = [{k: v[i] for k, v in md.items()}
+                            for i in range(n)]
             t2 = time.perf_counter()
             self.timer.add("data", t1 - t0)
             self.timer.add("train", t2 - t1)
-            self.timer.steps += 1
-            self.perf.update(metrics)
-            if hooks:
-                for h in hooks:
-                    h(step, metrics)
-            if self.display_now(step):
-                self.log(f"step-{step}: {self.perf.to_string()}")
-                self.log(self.timer.to_string())
-                self.perf.reset()
+            self.timer.steps += n
+            for i, m in enumerate(per_step):
+                s = step + i
+                self.perf.update(m)
+                if hooks:
+                    for h in hooks:
+                        h(s, m)
+                if self.display_now(s):
+                    self.log(f"step-{s}: {self.perf.to_string()}")
+                    self.log(self.timer.to_string())
+                    self.perf.reset()
+            last = step + n - 1
             if (ckpt is not None and self.cfg.checkpoint_frequency > 0
-                    and step >= self.cfg.checkpoint_after_steps
-                    and (step + 1) % self.cfg.checkpoint_frequency == 0):
-                ckpt.save(step + 1, params, opt_state)
+                    and last >= self.cfg.checkpoint_after_steps
+                    and (last + 1) % self.cfg.checkpoint_frequency == 0):
+                ckpt.save(last + 1, params, opt_state)
+            step += n
         if ckpt is not None and self.cfg.train_steps > start_step:
             ckpt.save(self.cfg.train_steps, params, opt_state)
         return params, opt_state, history

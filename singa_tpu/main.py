@@ -42,6 +42,10 @@ def make_argparser() -> argparse.ArgumentParser:
                     help="resume from latest checkpoint in the workspace")
     ap.add_argument("--workspace", default=None,
                     help="override ClusterProto.workspace")
+    ap.add_argument("--scan_chunk", type=int, default=0,
+                    help="run up to N steps per device dispatch (fused "
+                         "lax.scan inner loop; cadence events still fire "
+                         "at their exact steps)")
     return ap
 
 
@@ -149,7 +153,8 @@ def main(argv=None) -> int:
 
     params, opt_state, history = trainer.run(
         params, opt_state, train_iter, test_iter_factory=test_factory,
-        seed=args.seed, start_step=start_step, workspace=workspace)
+        seed=args.seed, start_step=start_step, workspace=workspace,
+        scan_chunk=args.scan_chunk)
     final = trainer.perf.to_string()
     print("training done" + (f": {final}" if final else
                              f" at step {model.train_steps}"))

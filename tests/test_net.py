@@ -255,3 +255,38 @@ def test_train_steps_scan_matches_per_step_calls():
             jax.random.fold_in(key, step))
         np.testing.assert_allclose(float(metrics2["loss"][step]),
                                    float(m["loss"]), rtol=1e-5)
+
+
+def test_run_scan_chunk_matches_per_step_run():
+    """run(scan_chunk=N) must produce the same params, display logs, and
+    test history as the per-step loop, with cadence at the same steps."""
+    cfg = load_model_config("/root/reference/examples/mnist/conv.conf")
+    cfg.train_steps = 11
+    cfg.display_frequency = 3
+    cfg.test_frequency = 5
+    cfg.test_steps = 2
+    rng = np.random.default_rng(11)
+    train_batches = [_mnist_batch(8, rng) for _ in range(cfg.train_steps)]
+    test_batches = [_mnist_batch(8, rng) for _ in range(cfg.test_steps)]
+
+    def run_with(chunk):
+        logs = []
+        tr = Trainer(cfg, MNIST_SHAPES, log_fn=logs.append, donate=False)
+        p, o = tr.init(seed=0)
+        p, o, hist = tr.run(p, o, iter(train_batches),
+                            test_iter_factory=lambda: iter(test_batches),
+                            seed=0, scan_chunk=chunk)
+        return p, hist, logs
+
+    p1, hist1, logs1 = run_with(0)
+    p4, hist4, logs4 = run_with(4)
+    for k in p1:
+        np.testing.assert_allclose(np.asarray(p4[k]), np.asarray(p1[k]),
+                                   atol=2e-5)
+    assert [h["step"] for h in hist1] == [h["step"] for h in hist4]
+    for h1, h4 in zip(hist1, hist4):
+        assert abs(h1["loss"] - h4["loss"]) < 1e-4
+    # same display steps (log lines starting with "step-N:")
+    steps1 = [l.split(":")[0] for l in logs1 if l.startswith("step-")]
+    steps4 = [l.split(":")[0] for l in logs4 if l.startswith("step-")]
+    assert steps1 == steps4
