@@ -2,15 +2,21 @@
 (/root/reference/tools/data_loader/data_loader.cc).
 
 Modes (same surface):
-  create: convert MNIST idx files or a CIFAR-10 binary folder into a
-          Shard of Record protos (data_loader.cc:112-145)
+  create: convert MNIST idx files, a CIFAR-10 binary folder, or an
+          ImageNet-style image folder + list file into a Shard of
+          Record protos (data_loader.cc:112-145; ImageNetSource
+          data_source.h:63-148: cv2 resize, CHW uint8)
   split:  re-partition a shard into N sub-shards (Split/SplitN,
           data_loader.cc:43-94)
+  mean:   compute the per-pixel float mean of a shard and write it as a
+          single Record (the reference's mean.binaryproto role)
 
 Usage:
   python -m singa_tpu.tools.loader create mnist  <images.idx> <labels.idx> <out_folder>
   python -m singa_tpu.tools.loader create cifar10 <data_batch.bin...> <out_folder>
+  python -m singa_tpu.tools.loader create imagefolder <img_dir> <list_file> <out_folder> [size]
   python -m singa_tpu.tools.loader split <in_folder> <out_prefix> <n>
+  python -m singa_tpu.tools.loader mean <shard_folder> <out_file>
 """
 
 from __future__ import annotations
@@ -55,6 +61,51 @@ def read_cifar10_bins(paths: List[str]) -> Iterator[Tuple[np.ndarray, int]]:
                     break
                 yield (np.frombuffer(row[1:], np.uint8).reshape(3, 32, 32),
                        row[0])
+
+
+def read_image_folder(img_dir: str, list_path: str, size: int = 256
+                      ) -> Iterator[Tuple[np.ndarray, int]]:
+    """ImageNet-style source (data_source.h:63-148): a list file of
+    `relative_path label` lines; each image is decoded + resized to
+    (size, size) with OpenCV and stored CHW uint8 (BGR channel order,
+    matching what the reference's cv-based loader wrote)."""
+    import cv2
+    with open(list_path) as f:
+        for line in f:
+            parts = line.split()
+            if not parts:
+                continue
+            name = parts[0]
+            label = int(parts[1]) if len(parts) > 1 else 0
+            img = cv2.imread(os.path.join(img_dir, name))
+            if img is None:
+                print(f"warning: unreadable image {name!r}, skipped",
+                      file=sys.stderr)
+                continue
+            img = cv2.resize(img, (size, size))
+            yield img.transpose(2, 0, 1), label
+
+
+def compute_mean(shard_folder: str, out_path: str) -> np.ndarray:
+    """Per-pixel float mean over every record of a shard, written as one
+    Record with `data` floats (the mean.binaryproto role; consumed as
+    the `mean` entry of the input batch for kRGBImage)."""
+    total = None
+    count = 0
+    with Shard(shard_folder, Shard.KREAD) as src:
+        for _, val in src:
+            rec = Record.decode(val).image
+            arr = rec.pixels_array().astype(np.float64)
+            total = arr if total is None else total + arr
+            count += 1
+    if not count:
+        raise ValueError(f"{shard_folder}: empty shard")
+    mean = (total / count).astype(np.float32)
+    out = Record(image=SingleLabelImageRecord(
+        shape=list(mean.shape), data=[float(x) for x in mean.ravel()]))
+    with open(out_path, "wb") as f:
+        f.write(out.encode())
+    return mean
 
 
 def create_shard(source: Iterator[Tuple[np.ndarray, int]], out_folder: str,
@@ -105,10 +156,19 @@ def main(argv=None) -> int:
         *bins, out = argv[2:]
         n = create_shard(read_cifar10_bins(bins), out)
         print(f"wrote {n} records to {out}")
+    elif cmd == "create" and len(argv) >= 2 and argv[1] == "imagefolder":
+        img_dir, list_file, out = argv[2:5]
+        size = int(argv[5]) if len(argv) > 5 else 256
+        n = create_shard(read_image_folder(img_dir, list_file, size), out)
+        print(f"wrote {n} records to {out}")
     elif cmd == "split":
         in_folder, out_prefix, n = argv[1], argv[2], int(argv[3])
         counts = split_shard(in_folder, out_prefix, n)
         print(f"split into {counts}")
+    elif cmd == "mean":
+        shard_folder, out_path = argv[1], argv[2]
+        mean = compute_mean(shard_folder, out_path)
+        print(f"wrote mean {mean.shape} to {out_path}")
     else:
         print(__doc__)
         return 2

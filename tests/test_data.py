@@ -205,3 +205,38 @@ def test_token_streams_share_language_across_seeds():
         hits = np.mean([tgt[i, t] in table[inp[i, t]]
                         for i in range(8) for t in range(128)])
         assert hits > 0.8, f"seed {seed}: only {hits:.2f} follow the table"
+
+
+def test_loader_tool_imagefolder_and_mean(tmp_path):
+    """ImageNet-style folder -> shard (cv2 resize, CHW uint8) + per-pixel
+    mean record (the mean.binaryproto role)."""
+    cv2 = pytest.importorskip("cv2")
+    from singa_tpu.tools import loader
+    img_dir = tmp_path / "imgs"
+    os.makedirs(img_dir)
+    rng = np.random.default_rng(0)
+    lines = []
+    for i in range(4):
+        img = rng.integers(0, 256, (40 + i, 30, 3)).astype(np.uint8)
+        cv2.imwrite(str(img_dir / f"im{i}.png"), img)
+        lines.append(f"im{i}.png {i % 2}")
+    lst = tmp_path / "list.txt"
+    lst.write_text("\n".join(lines) + "\n")
+
+    out = tmp_path / "shard"
+    n = loader.create_shard(
+        loader.read_image_folder(str(img_dir), str(lst), size=16), str(out))
+    assert n == 4
+    with Shard(str(out), Shard.KREAD) as sh:
+        recs = [Record.decode(v).image for _, v in sh]
+    assert all(tuple(r.shape) == (3, 16, 16) for r in recs)
+    assert [r.label for r in recs] == [0, 1, 0, 1]
+
+    mean_path = tmp_path / "mean.rec"
+    mean = loader.compute_mean(str(out), str(mean_path))
+    assert mean.shape == (3, 16, 16)
+    stored = Record.decode(mean_path.read_bytes()).image
+    np.testing.assert_allclose(stored.pixels_array(), mean, rtol=1e-6)
+    expect = np.mean([r.pixels_array().astype(np.float64) for r in recs],
+                     axis=0)
+    np.testing.assert_allclose(mean, expect, atol=1e-4)
