@@ -150,17 +150,30 @@ class LMDBDataLayer(ShardDataLayer):
 class MnistImageLayer(Layer):
     """Parser (layer.cc:380-473): uint8 pixels → (x/norm_a - norm_b),
     output (B, s, s).  The reference does this per-pixel on the host; here
-    it runs inside the jitted step (zero CPU in the inner loop)."""
+    it runs inside the jitted step (zero CPU in the inner loop).
+
+    The elastic-distortion surface the reference declares but left
+    commented out (MnistProto kernel/sigma/alpha/beta/gamma,
+    model.proto:211-225) is implemented on-device (ops/augment.py) and
+    applied in the training phase when any strength is nonzero."""
 
     def setup(self, src_shapes):
         p = self.cfg.mnist_param
         self.norm_a = p.norm_a if p else 1.0
         self.norm_b = p.norm_b if p else 0.0
+        self.distort = dict(
+            kernel=p.kernel, sigma=p.sigma, alpha=p.alpha,
+            beta=p.beta, gamma=p.gamma) if p else {}
+        self.distort_on = bool(p and (
+            (p.alpha > 0 and p.kernel > 0) or p.beta > 0 or p.gamma > 0))
         pix = src_shapes[0]["pixel"]
         self.out_shape = tuple(pix)
 
     def apply(self, params, srcs, ctx):
         x = srcs[0]["pixel"].astype(jnp.float32)
+        if self.distort_on and ctx.train:
+            from ..ops.augment import elastic_deform
+            x = elastic_deform(x, ctx.layer_rng(), **self.distort)
         return x / self.norm_a - self.norm_b
 
 

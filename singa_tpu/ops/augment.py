@@ -1,0 +1,90 @@
+"""On-device image augmentation — the elastic-distortion surface of the
+reference's MNIST parser.
+
+Reference: MnistProto (model.proto:211-225) declares kernel/sigma/alpha
+(elastic displacement field), beta (rotation/shear, degrees), gamma
+(scaling, percent), resize and elastic_freq — but the implementation in
+layer.cc:380-473 is commented out.  Here the full Simard-2003-style
+pipeline is real and runs *inside the jitted step* (the reference would
+have done it per-pixel on the host): random displacement fields smoothed
+by a Gaussian kernel, composed with a random rotation+scaling affine map,
+sampled bilinearly.  Everything is vectorized over the batch, so the
+augmentation cost is a few elementwise kernels and one gather on TPU.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def gaussian_kernel(size: int, sigma: float) -> jnp.ndarray:
+    """Normalized (size, size) Gaussian filter."""
+    r = jnp.arange(size, dtype=jnp.float32) - (size - 1) / 2.0
+    g = jnp.exp(-(r ** 2) / (2.0 * max(sigma, 1e-6) ** 2))
+    k = jnp.outer(g, g)
+    return k / jnp.sum(k)
+
+
+def _blur(field: jnp.ndarray, kernel: jnp.ndarray) -> jnp.ndarray:
+    """Depthwise SAME blur of a (B, H, W) field."""
+    b, h, w = field.shape
+    k = kernel.shape[0]
+    out = jax.lax.conv_general_dilated(
+        field[:, None], kernel[None, None],
+        window_strides=(1, 1),
+        padding=[(k // 2, (k - 1) // 2), (k // 2, (k - 1) // 2)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return out[:, 0]
+
+
+def elastic_deform(x: jnp.ndarray, key: jax.Array, *, kernel: int = 0,
+                   sigma: float = 0.0, alpha: float = 0.0,
+                   beta: float = 0.0, gamma: float = 0.0) -> jnp.ndarray:
+    """Random elastic + affine deformation of a batch of images.
+
+    x: (B, H, W) float.  Per image: displacement field = Gaussian-blurred
+    uniform(-1,1) noise scaled by `alpha` pixels (when kernel>0); affine =
+    rotation by U(-beta, beta) degrees and axis scaling by
+    U(1-gamma/100, 1+gamma/100), about the image center.  Bilinear
+    sampling with edge clamping.  All parameters zero → identity.
+    """
+    b, h, w = x.shape
+    k_rot, k_sc, k_dx, k_dy = jax.random.split(key, 4)
+
+    yy, xx = jnp.meshgrid(jnp.arange(h, dtype=jnp.float32),
+                          jnp.arange(w, dtype=jnp.float32), indexing="ij")
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    yc, xc = yy - cy, xx - cx                       # centered grid (H, W)
+
+    # inverse affine per image: rotate by -theta, scale by 1/s
+    theta = (jax.random.uniform(k_rot, (b,), minval=-beta, maxval=beta)
+             * math.pi / 180.0)
+    scale = 1.0 + jax.random.uniform(k_sc, (b, 2), minval=-gamma,
+                                     maxval=gamma) / 100.0
+    cos, sin = jnp.cos(theta), jnp.sin(theta)
+    # source coords = R(-theta) @ (grid / scale)
+    gy = yc[None] / scale[:, 0, None, None]
+    gx = xc[None] / scale[:, 1, None, None]
+    src_y = cos[:, None, None] * gy + sin[:, None, None] * gx
+    src_x = -sin[:, None, None] * gy + cos[:, None, None] * gx
+
+    if kernel > 0 and alpha > 0:
+        kern = gaussian_kernel(kernel, sigma)
+        dy = _blur(jax.random.uniform(k_dy, (b, h, w), minval=-1.0,
+                                      maxval=1.0), kern) * alpha
+        dx = _blur(jax.random.uniform(k_dx, (b, h, w), minval=-1.0,
+                                      maxval=1.0), kern) * alpha
+        src_y = src_y + dy
+        src_x = src_x + dx
+
+    coords_y = jnp.clip(src_y + cy, 0.0, h - 1)
+    coords_x = jnp.clip(src_x + cx, 0.0, w - 1)
+
+    def sample(img, cy_, cx_):
+        return jax.scipy.ndimage.map_coordinates(
+            img, [cy_, cx_], order=1, mode="nearest")
+
+    return jax.vmap(sample)(x, coords_y, coords_x)

@@ -199,3 +199,69 @@ def test_linear_golden():
     got = ops.linear(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
     want = x.reshape(3, 8) @ w + b
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+def test_elastic_deform_identity_and_transforms():
+    """ops/augment: zero strengths = identity; rotation/scale/elastic move
+    pixels as expected; deterministic under a fixed key."""
+    import jax
+    from singa_tpu.ops.augment import elastic_deform
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((3, 17, 17)).astype(np.float32))
+    key = jax.random.PRNGKey(0)
+
+    out = elastic_deform(x, key)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), atol=1e-5)
+
+    # the rotation center is a fixed point of a pure rotation
+    delta = jnp.zeros((1, 17, 17)).at[0, 8, 8].set(1.0)
+    rot = elastic_deform(delta, key, beta=45.0)
+    assert float(rot[0, 8, 8]) > 0.99
+
+    # elastic displacement changes the image but is deterministic
+    e1 = elastic_deform(x, key, kernel=5, sigma=2.0, alpha=3.0)
+    e2 = elastic_deform(x, key, kernel=5, sigma=2.0, alpha=3.0)
+    e3 = elastic_deform(x, jax.random.PRNGKey(1), kernel=5, sigma=2.0,
+                        alpha=3.0)
+    np.testing.assert_allclose(np.asarray(e1), np.asarray(e2))
+    assert float(jnp.max(jnp.abs(e1 - x))) > 1e-3
+    assert float(jnp.max(jnp.abs(e1 - e3))) > 1e-3
+
+
+def test_mnist_layer_applies_distortion_only_in_train():
+    """kMnistImage runs the declared-but-unimplemented reference
+    distortion surface (MnistProto) on-device in the train phase only."""
+    import jax
+    from singa_tpu.config import model_config_from_text
+    from singa_tpu.core import build_net
+    text = """
+    neuralnet {
+      layer { name: "data" type: "kShardData" data_param { batchsize: 4 } }
+      layer { name: "mnist" type: "kMnistImage" srclayers: "data"
+              mnist_param { kernel: 5 sigma: 2.0 alpha: 4.0 beta: 10.0
+                            norm_a: 255.0 } }
+      layer { name: "lab" type: "kLabel" srclayers: "data" }
+      layer { name: "fc" type: "kInnerProduct" srclayers: "mnist"
+              inner_product_param { num_output: 10 }
+              param { name: "weight" init_method: kUniform }
+              param { name: "bias" init_method: kConstant value: 0 } }
+      layer { name: "loss" type: "kSoftmaxLoss" srclayers: "fc"
+              srclayers: "lab" }
+    }
+    """
+    cfg = model_config_from_text(text)
+    net = build_net(cfg, "kTrain", {"data": {"pixel": (28, 28),
+                                             "label": ()}})
+    params = net.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(1)
+    batch = {"data": {
+        "pixel": jnp.asarray(rng.integers(0, 256, (4, 28, 28))
+                             .astype(np.uint8)),
+        "label": jnp.asarray(rng.integers(0, 10, (4,)))}}
+    _, _, out_train = net.apply(params, batch, rng=jax.random.PRNGKey(3),
+                                train=True)
+    _, _, out_eval = net.apply(params, batch, train=False)
+    plain = np.asarray(batch["data"]["pixel"], np.float32) / 255.0
+    np.testing.assert_allclose(np.asarray(out_eval["mnist"]), plain,
+                               atol=1e-6)
+    assert float(jnp.max(jnp.abs(out_train["mnist"] - plain))) > 1e-4
