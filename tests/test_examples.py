@@ -65,3 +65,32 @@ def test_cli_runs_example_end_to_end():
              "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
     assert out.returncode == 0, out.stderr[-2000:]
     assert "mesh: " in out.stdout and "training done" in out.stdout
+
+
+def test_viz_dot_and_log_plot(tmp_path):
+    """tools/viz: net JSON -> dot (script/graph.py role) and training-log
+    -> curves (script/draw.py role)."""
+    from singa_tpu.config import load_model_config
+    from singa_tpu.core import build_net
+    from singa_tpu.tools.viz import (json_to_dot, parse_training_log,
+                                     plot_training_log)
+
+    cfg = load_model_config("/root/reference/examples/mnist/conv.conf")
+    net = build_net(cfg, "kTrain", {"data": {"pixel": (28, 28),
+                                             "label": ()}}, batchsize=2)
+    dot = json_to_dot(net.to_json())
+    assert dot.startswith("digraph")
+    for name in net.topo:
+        assert f'"{name}"' in dot
+    assert '"conv1" -> "pool1";' in dot
+
+    log = ("step-0: loss : 2.301234, precision : 0.101562\n"
+           "junk line\n"
+           "step-30 test: loss : 2.100000, precision : 0.301000\n"
+           "step-30: loss : 1.900111, precision : 0.401222\n")
+    series = parse_training_log(log)
+    assert series["train"]["step"] == [0, 30]
+    assert series["test"]["precision"] == [0.301]
+    out = tmp_path / "curves.png"
+    metrics = plot_training_log(log, str(out))
+    assert "loss" in metrics and out.exists() and out.stat().st_size > 0
