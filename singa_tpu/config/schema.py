@@ -225,6 +225,8 @@ class MoEConfig:
 class EmbedConfig:
     vocab_size: int = 0
     embed_dim: int = 0
+    # token-chunk size of the fused kLMHeadLoss layer (0 = default 4096)
+    loss_chunk: int = 0
 
 
 @dataclass

@@ -324,3 +324,43 @@ class LMHeadLayer(Layer):
             w = w.astype(ctx.compute_dtype)
         return jnp.einsum("bse,ev->bsv", srcs[0], w,
                           preferred_element_type=jnp.float32)
+
+
+@register_layer("kLMHeadLoss")
+class LMHeadLossLayer(Layer):
+    """Fused LM head + softmax-xent + top-k precision: (B, S, E) hidden
+    + (B, S) labels → metrics, WITHOUT materializing (B, S, V) logits
+    (ops.loss.chunked_lm_xent: chunked scan, checkpointed recompute in
+    the backward).  Numerically identical to kLMHead → kSoftmaxLoss; use
+    this form for large vocabularies where the logits tensor would
+    dominate HBM traffic."""
+
+    is_loss = True
+
+    def setup(self, src_shapes):
+        p = self.cfg.embed_param
+        if p is None or not p.vocab_size:
+            raise LayerError(f"{self.name}: embed_param.vocab_size required")
+        b, s, e = tuple(src_shapes[0])
+        lp = self.cfg.softmaxloss_param
+        self.topk = lp.topk if lp else 1
+        self.scale = lp.scale if lp else 1.0
+        self.chunk = p.loss_chunk or 4096
+        self.tied = bool(self.cfg.share_param)
+        self.w_key = _declare_with_default(
+            self, 0, "w", (e, p.vocab_size), 1.0 / math.sqrt(e), 1)
+        self.out_shape = (2,)
+
+    def apply(self, params, srcs, ctx):
+        from ..ops.loss import chunked_lm_xent
+        hidden, labels = srcs
+        w = params[self.w_key]
+        if self.tied:
+            w = w.T
+        if ctx.compute_dtype is not None:
+            w = w.astype(ctx.compute_dtype)
+        b, s, e = hidden.shape
+        loss, prec = chunked_lm_xent(
+            hidden.reshape(b * s, e), w, labels.reshape(-1),
+            chunk_size=self.chunk, topk=self.topk, scale=self.scale)
+        return {"loss": loss, "precision": prec}

@@ -36,7 +36,11 @@ def transformer_lm(vocab_size: int = 32000,
                    train_steps: int = 1000,
                    learning_rate: float = 3e-4,
                    precision: str = "float32",
-                   tie_embeddings: bool = True) -> ModelConfig:
+                   tie_embeddings: bool = True,
+                   fused_head: bool = True) -> ModelConfig:
+    """`fused_head` emits the kLMHeadLoss layer (chunked projection+xent,
+    no (B,S,V) logits tensor) instead of kLMHead → kSoftmaxLoss; the two
+    forms are numerically identical."""
     ffn_hidden = ffn_hidden or int(embed_dim * 8 / 3 // 64 * 64) or 256
     layers: List[Dict] = [
         {"name": "data", "type": "kSequenceData",
@@ -81,16 +85,27 @@ def transformer_lm(vocab_size: int = 32000,
         src = f"res{i}b"
 
     layers.append({"name": "ln_f", "type": "kRMSNorm", "srclayers": src})
-    head = {"name": "lm_head", "type": "kLMHead", "srclayers": "ln_f",
-            "embed_param": {"vocab_size": vocab_size,
-                            "embed_dim": embed_dim}}
-    if tie_embeddings:
-        head["share_param"] = ["embed/embedding"]
-        head["param"] = [{"name": "w"}]
-    layers.append(head)
-    layers.append({"name": "loss", "type": "kSoftmaxLoss",
-                   "srclayers": ["lm_head", "labels"],
-                   "softmaxloss_param": {"topk": 1}})
+    if fused_head:
+        head = {"name": "loss", "type": "kLMHeadLoss",
+                "srclayers": ["ln_f", "labels"],
+                "embed_param": {"vocab_size": vocab_size,
+                                "embed_dim": embed_dim},
+                "softmaxloss_param": {"topk": 1}}
+        if tie_embeddings:
+            head["share_param"] = ["embed/embedding"]
+            head["param"] = [{"name": "w"}]
+        layers.append(head)
+    else:
+        head = {"name": "lm_head", "type": "kLMHead", "srclayers": "ln_f",
+                "embed_param": {"vocab_size": vocab_size,
+                                "embed_dim": embed_dim}}
+        if tie_embeddings:
+            head["share_param"] = ["embed/embedding"]
+            head["param"] = [{"name": "w"}]
+        layers.append(head)
+        layers.append({"name": "loss", "type": "kSoftmaxLoss",
+                       "srclayers": ["lm_head", "labels"],
+                       "softmaxloss_param": {"topk": 1}})
 
     return model_config_from_dict({
         "name": f"transformer-lm-{num_layers}L{embed_dim}E",

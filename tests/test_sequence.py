@@ -227,7 +227,7 @@ def test_tied_lm_head_with_vocab_equal_embed():
     vocab = 64
     cfg = transformer_lm(vocab_size=vocab, num_layers=1, embed_dim=vocab,
                          num_heads=4, head_dim=16, seq_len=32, batchsize=4,
-                         tie_embeddings=True)
+                         tie_embeddings=True, fused_head=False)
     tr = Trainer(cfg, {"data": {"input": (32,), "target": (32,)}},
                  donate=False)
     params, opt = tr.init(0)
@@ -264,3 +264,58 @@ def test_moe_capacity_drops_overflow():
     served_tight = int(jnp.sum(jnp.any(jnp.abs(out_tight) > 1e-6, -1)))
     assert served_full == 8
     assert served_tight == 1
+
+
+def test_chunked_lm_xent_matches_naive():
+    """Fused chunked head+xent == materialized logits path, including
+    gradients (the backward recomputes chunks under jax.checkpoint)."""
+    import jax
+    from singa_tpu.ops.loss import chunked_lm_xent, softmax_loss_metrics
+    rng = np.random.default_rng(0)
+    n, e, v = 24, 16, 50
+    h = jnp.asarray(rng.standard_normal((n, e)).astype(np.float32))
+    w = jnp.asarray(rng.standard_normal((e, v)).astype(np.float32)) * 0.1
+    labels = jnp.asarray(rng.integers(0, v, (n,)))
+
+    loss_f, prec_f = chunked_lm_xent(h, w, labels, chunk_size=7, topk=2)
+    loss_n, prec_n = softmax_loss_metrics(h @ w, labels, topk=2)
+    np.testing.assert_allclose(float(loss_f), float(loss_n), rtol=1e-6)
+    np.testing.assert_allclose(float(prec_f), float(prec_n), rtol=1e-6)
+
+    gf = jax.grad(lambda h_, w_: chunked_lm_xent(h_, w_, labels, 7)[0],
+                  argnums=(0, 1))(h, w)
+    gn = jax.grad(lambda h_, w_: softmax_loss_metrics(h_ @ w_, labels)[0],
+                  argnums=(0, 1))(h, w)
+    np.testing.assert_allclose(np.asarray(gf[0]), np.asarray(gn[0]),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gf[1]), np.asarray(gn[1]),
+                               atol=1e-5)
+
+
+def test_fused_head_model_matches_unfused():
+    """transformer_lm(fused_head=True) trains identically to the
+    kLMHead+kSoftmaxLoss form (tied embeddings -> same param pytree)."""
+    import jax
+    from singa_tpu.core.trainer import Trainer
+    from singa_tpu.models.transformer import (synthetic_token_batches,
+                                              transformer_lm)
+    kw = dict(vocab_size=64, num_layers=2, embed_dim=32, num_heads=4,
+              head_dim=8, seq_len=32, batchsize=4)
+    shapes = {"data": {"input": (32,), "target": (32,)}}
+    batch = next(synthetic_token_batches(4, 32, 64))
+    out = {}
+    for fused in (True, False):
+        cfg = transformer_lm(fused_head=fused, **kw)
+        tr = Trainer(cfg, shapes, donate=False, log_fn=lambda s: None)
+        params, opt = tr.init(0)
+        p, o, m = tr.train_step(params, opt, batch, 0, jax.random.PRNGKey(0))
+        out[fused] = (set(params), p, m)
+    assert out[True][0] == out[False][0]          # same param keys (tied)
+    np.testing.assert_allclose(float(out[True][2]["loss"]),
+                               float(out[False][2]["loss"]), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(out[True][2]["precision"]),
+        float(out[False][2]["precision"]), rtol=1e-5)
+    for k in out[True][1]:
+        np.testing.assert_allclose(np.asarray(out[True][1][k]),
+                                   np.asarray(out[False][1][k]), atol=2e-5)
