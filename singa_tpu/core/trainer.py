@@ -262,15 +262,41 @@ class Trainer:
         prefetched and stacked on the host, the device runs the whole
         chunk without host round-trips, and cadence events (test/
         validate/checkpoint/display) still fire at exactly the reference
-        steps because chunks are cut at their boundaries."""
+        steps because chunks are cut at their boundaries.
+
+        Preemption safety (the failure-recovery story the reference
+        lacks, SURVEY.md §5 — any process death hangs its job): while a
+        checkpoint manager is active, SIGTERM/SIGINT trigger a final
+        snapshot at the current step and a clean early return, so a
+        preempted TPU job resumes from where it stopped instead of its
+        last cadence checkpoint."""
         ckpt = None
         if workspace and self.cfg.checkpoint_frequency > 0:
             from ..utils.checkpoint import CheckpointManager
             ckpt = CheckpointManager(workspace)
+        interrupted = []
+        old_handlers = {}
+        if ckpt is not None:
+            import signal
+
+            def _on_signal(signum, frame):
+                interrupted.append(signum)
+
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    old_handlers[sig] = signal.signal(sig, _on_signal)
+                except ValueError:   # non-main thread: no signal hooks
+                    break
+
         rng = jax.random.PRNGKey(seed ^ 0x5eed)
         history: List[Dict[str, float]] = []
         step = start_step
         while step < self.cfg.train_steps:
+            if interrupted:
+                self.log(f"signal {interrupted[0]} received: checkpointing "
+                         f"at step {step} and stopping")
+                ckpt.save(step, params, opt_state)
+                break
             if self.val_step and self.validate_now(step) and val_iter_factory:
                 avg = self.evaluate(params, val_iter_factory(),
                                     self.cfg.validation_steps, self.val_step)
@@ -324,7 +350,12 @@ class Trainer:
                     and (last + 1) % self.cfg.checkpoint_frequency == 0):
                 ckpt.save(last + 1, params, opt_state)
             step += n
-        if ckpt is not None and self.cfg.train_steps > start_step:
+        if old_handlers:
+            import signal
+            for sig, h in old_handlers.items():
+                signal.signal(sig, h)
+        if (ckpt is not None and not interrupted
+                and self.cfg.train_steps > start_step):
             ckpt.save(self.cfg.train_steps, params, opt_state)
         return params, opt_state, history
 

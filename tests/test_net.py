@@ -290,3 +290,37 @@ def test_run_scan_chunk_matches_per_step_run():
     steps1 = [l.split(":")[0] for l in logs1 if l.startswith("step-")]
     steps4 = [l.split(":")[0] for l in logs4 if l.startswith("step-")]
     assert steps1 == steps4
+
+
+def test_preemption_signal_checkpoints_and_resumes(tmp_path):
+    """SIGTERM mid-run -> snapshot at the current step + clean stop;
+    resume() continues from there (the recovery story the reference
+    lacks: a killed worker hung the whole job)."""
+    import os
+    import signal
+
+    cfg = load_model_config("/root/reference/examples/mnist/conv.conf")
+    cfg.train_steps = 50
+    cfg.test_frequency = 0
+    cfg.display_frequency = 0
+    cfg.checkpoint_frequency = 1000   # cadence would never fire
+    trainer = Trainer(cfg, MNIST_SHAPES, log_fn=lambda s: None,
+                      donate=False)
+    params, opt_state = trainer.init(seed=0)
+    rng = np.random.default_rng(21)
+    batches = [_mnist_batch(8, rng) for _ in range(50)]
+
+    def self_sigterm(step, metrics):
+        if step == 4:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    p, o, _ = trainer.run(params, opt_state, iter(batches),
+                          hooks=[self_sigterm], workspace=str(tmp_path))
+    p2, o2, start = trainer.resume(params, opt_state, str(tmp_path))
+    assert start == 5                      # stopped after finishing step 4
+    for k in p:
+        np.testing.assert_allclose(np.asarray(p2[k]), np.asarray(p[k]))
+    # handler restored: SIGTERM must not be swallowed anymore
+    assert signal.getsignal(signal.SIGTERM) in (
+        signal.SIG_DFL, signal.default_int_handler) or callable(
+        signal.getsignal(signal.SIGTERM))
