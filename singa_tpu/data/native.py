@@ -45,12 +45,65 @@ def load_library() -> Optional[ctypes.CDLL]:
                                  ctypes.c_uint64]
     lib.shard_flush.argtypes = [ctypes.c_void_p]
     lib.shard_close_write.argtypes = [ctypes.c_void_p]
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.record_probe.restype = ctypes.c_int
+    lib.record_probe.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int),
+        u64p, ctypes.POINTER(ctypes.c_int32)]
+    lib.record_batch_decode.restype = ctypes.c_long
+    lib.record_batch_decode.argtypes = [
+        ctypes.c_char_p, u64p, u64p, ctypes.c_long,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_int32)]
     _lib = lib
     return lib
 
 
 def available() -> bool:
     return load_library() is not None
+
+
+def decode_image_batch(vals):
+    """Decode a list of serialized Record protos into (pixels, labels)
+    via the C++ walker (native/record_codec.cc) — one memcpy per record.
+
+    Returns (uint8 ndarray (n, *shape), int32 ndarray (n,)), or None
+    when the library isn't built or the records aren't uniform uint8
+    pixel images (caller falls back to the Python codec).
+    """
+    import numpy as np
+    lib = load_library()
+    if lib is None or not vals:
+        return None
+    shape = (ctypes.c_int64 * 4)()
+    ndim = ctypes.c_int()
+    plen = ctypes.c_uint64()
+    label = ctypes.c_int32()
+    if lib.record_probe(vals[0], len(vals[0]), shape, ctypes.byref(ndim),
+                        ctypes.byref(plen), ctypes.byref(label)) != 0:
+        return None
+    dims = tuple(shape[i] for i in range(ndim.value))
+    if not dims or plen.value != int(np.prod(dims)):
+        return None   # float-data or shapeless record: Python path
+    n = len(vals)
+    buf = b"".join(vals)
+    offsets = (ctypes.c_uint64 * n)()
+    lens = (ctypes.c_uint64 * n)()
+    off = 0
+    for i, v in enumerate(vals):
+        offsets[i] = off
+        lens[i] = len(v)
+        off += len(v)
+    pixels = np.empty((n,) + dims, np.uint8)
+    labels = np.empty((n,), np.int32)
+    got = lib.record_batch_decode(
+        buf, offsets, lens, n,
+        pixels.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        plen.value, labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    if got != n:
+        return None
+    return pixels, labels
 
 
 class NativeShardReader:

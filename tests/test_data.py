@@ -240,3 +240,42 @@ def test_loader_tool_imagefolder_and_mean(tmp_path):
     expect = np.mean([r.pixels_array().astype(np.float64) for r in recs],
                      axis=0)
     np.testing.assert_allclose(mean, expect, atol=1e-4)
+
+
+def test_native_record_batch_decode_matches_python(tmp_path):
+    """C++ record_batch_decode == Python codec on the same shard, and
+    shard_batches uses it transparently."""
+    native = pytest.importorskip("singa_tpu.data.native")
+    if not native.available():
+        pytest.skip("native library not built")
+    from singa_tpu.data.pipeline import shard_batches
+
+    rng = np.random.default_rng(9)
+    folder = tmp_path / "s"
+    os.makedirs(folder)
+    recs = []
+    with Shard(str(folder), Shard.KCREATE) as sh:
+        for i in range(7):
+            img = rng.integers(0, 256, (3, 5, 4)).astype(np.uint8)
+            rec = Record(image=SingleLabelImageRecord(
+                shape=[3, 5, 4], label=i % 3, pixel=img.tobytes()))
+            sh.insert(f"k{i}", rec.encode())
+            recs.append((img, i % 3))
+
+    vals = [Record(image=SingleLabelImageRecord(
+        shape=[3, 5, 4], label=lb, pixel=im.tobytes())).encode()
+        for im, lb in recs]
+    out = native.decode_image_batch(vals)
+    assert out is not None
+    pixels, labels = out
+    assert pixels.shape == (7, 3, 5, 4) and pixels.dtype == np.uint8
+    np.testing.assert_array_equal(labels, [r[1] for r in recs])
+    for i, (im, _) in enumerate(recs):
+        np.testing.assert_array_equal(pixels[i], im)
+
+    # malformed record -> graceful None (fallback path)
+    assert native.decode_image_batch([b"\xff\xff\xff"]) is None
+
+    batches = list(shard_batches(str(folder), 3, loop=False))
+    assert [b["data"]["pixel"].shape[0] for b in batches] == [3, 3, 1]
+    np.testing.assert_array_equal(batches[0]["data"]["pixel"][0], recs[0][0])
