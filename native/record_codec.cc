@@ -120,6 +120,12 @@ bool find_image(const uint8_t* buf, uint64_t len, const uint8_t** img,
       uint64_t ln;
       if (!read_varint(&c, &ln) || ln > uint64_t(c.end - c.p)) return false;
       c.p += ln;
+    } else if (wt == 5) {
+      if (c.end - c.p < 4) return false;
+      c.p += 4;
+    } else if (wt == 1) {
+      if (c.end - c.p < 8) return false;
+      c.p += 8;
     } else {
       return false;
     }
@@ -145,19 +151,21 @@ int record_probe(const uint8_t* buf, uint64_t len, int64_t* shape_out,
   return 0;
 }
 
-// Decode n records (concatenated in buf at offsets[i], lens[i]) into
+// Decode n records (recs[i], lens[i] — no concatenation needed) into
 // pixels_out (n * pixel_len uint8, contiguous) + labels_out (n int32).
-// Every record must carry exactly pixel_len pixel bytes. Returns the
-// number decoded (== n on success); on the first malformed or
-// wrong-sized record i, returns -(i+1).
-long record_batch_decode(const uint8_t* buf, const uint64_t* offsets,
-                         const uint64_t* lens, long n,
-                         uint8_t* pixels_out, uint64_t pixel_len,
-                         int32_t* labels_out) {
+// Every record must carry exactly pixel_len pixel bytes AND the same
+// shape as (expect_shape, expect_ndim) — same-size different-shape
+// records are rejected, not silently reinterpreted. Returns the number
+// decoded (== n on success); on the first malformed, wrong-sized, or
+// wrong-shaped record i, returns -(i+1).
+long record_batch_decode(const uint8_t* const* recs, const uint64_t* lens,
+                         long n, const int64_t* expect_shape,
+                         int expect_ndim, uint8_t* pixels_out,
+                         uint64_t pixel_len, int32_t* labels_out) {
   for (long i = 0; i < n; ++i) {
     const uint8_t* img;
     uint64_t img_len;
-    if (!find_image(buf + offsets[i], lens[i], &img, &img_len))
+    if (!find_image(recs[i], lens[i], &img, &img_len))
       return -(i + 1);
     int64_t shape[4];
     int ndim;
@@ -167,6 +175,9 @@ long record_batch_decode(const uint8_t* buf, const uint64_t* offsets,
     if (!parse_image(img, img_len, shape, &ndim, &pixel, &plen, &label))
       return -(i + 1);
     if (plen != pixel_len || pixel == nullptr) return -(i + 1);
+    if (ndim != expect_ndim) return -(i + 1);
+    for (int d = 0; d < ndim; ++d)
+      if (shape[d] != expect_shape[d]) return -(i + 1);
     std::memcpy(pixels_out + static_cast<uint64_t>(i) * pixel_len, pixel,
                 pixel_len);
     labels_out[i] = label;

@@ -53,7 +53,8 @@ def load_library() -> Optional[ctypes.CDLL]:
         u64p, ctypes.POINTER(ctypes.c_int32)]
     lib.record_batch_decode.restype = ctypes.c_long
     lib.record_batch_decode.argtypes = [
-        ctypes.c_char_p, u64p, u64p, ctypes.c_long,
+        ctypes.POINTER(ctypes.c_char_p), u64p, ctypes.c_long,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
         ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
         ctypes.POINTER(ctypes.c_int32)]
     _lib = lib
@@ -87,18 +88,14 @@ def decode_image_batch(vals):
     if not dims or plen.value != int(np.prod(dims)):
         return None   # float-data or shapeless record: Python path
     n = len(vals)
-    buf = b"".join(vals)
-    offsets = (ctypes.c_uint64 * n)()
-    lens = (ctypes.c_uint64 * n)()
-    off = 0
-    for i, v in enumerate(vals):
-        offsets[i] = off
-        lens[i] = len(v)
-        off += len(v)
+    # per-record pointers into the bytes objects (held alive by `vals`) —
+    # no concatenation copy of the batch payload
+    recs = (ctypes.c_char_p * n)(*vals)
+    lens = (ctypes.c_uint64 * n)(*(len(v) for v in vals))
     pixels = np.empty((n,) + dims, np.uint8)
     labels = np.empty((n,), np.int32)
     got = lib.record_batch_decode(
-        buf, offsets, lens, n,
+        recs, lens, n, shape, ndim.value,
         pixels.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         plen.value, labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
     if got != n:

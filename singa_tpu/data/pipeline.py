@@ -15,23 +15,23 @@ from typing import Callable, Dict, Iterator, List, Optional
 
 import numpy as np
 
-from .records import Record
+from .records import Record, record_has_image
 from .shard import Shard
 
 
 def _decode_batch(vals: List[bytes], data_layer: str) -> Dict:
     """Decode a batch of serialized records — native C++ batch decoder
-    when built (one memcpy per record), Python codec otherwise."""
+    when built (one memcpy per record), Python codec otherwise.  Callers
+    filter image-less records before batching (record_has_image), so
+    every val here contributes one batch row."""
     from . import native
-    fast = native.decode_image_batch(vals) if native.available() else None
+    fast = native.decode_image_batch(vals)
     if fast is not None:
         pixels, labels = fast
         return {data_layer: {"pixel": pixels, "label": labels}}
     pixels, labels = [], []
     for val in vals:
         rec = Record.decode(val)
-        if rec.image is None:
-            continue
         pixels.append(rec.image.pixels_array())
         labels.append(rec.image.label)
     return {data_layer: {"pixel": np.stack(pixels),
@@ -52,6 +52,8 @@ def shard_batches(folder: str, batchsize: int, data_layer: str = "data",
             if skip > 0:
                 skip -= 1
                 continue
+            if not record_has_image(val):
+                continue   # type-only records contribute no batch row
             vals.append(val)
             if len(vals) == batchsize:
                 yield _decode_batch(vals, data_layer)

@@ -157,6 +157,19 @@ class Record:
         return rec
 
 
+def record_has_image(buf: bytes) -> bool:
+    """Whether a serialized Record carries an image submessage — a
+    tag-walk only (no submessage parse), cheap enough for the input
+    pipeline to filter image-less records before batching."""
+    try:
+        for fn, wt, _ in _iter_fields(buf):
+            if fn == 2 and wt == _WT_LEN:
+                return True
+    except (ValueError, IndexError):
+        return False
+    return False
+
+
 @dataclass
 class Datum:
     """caffe's LMDB record (model.proto:288-299)."""

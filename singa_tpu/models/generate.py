@@ -1,0 +1,192 @@
+"""Autoregressive inference for the transformer LM family: KV-cache
+prefill + single-token decode, compiled as two XLA programs.
+
+The reference framework is train/test only (worker.cc Test loop runs
+Forward over labelled batches; there is no sampling path) — generation
+is a capability the sequence-model family adds.  TPU-first design:
+
+- static shapes everywhere: the cache is allocated at `max_len` up
+  front and written with `lax.dynamic_update_slice`; the decode loop is
+  one `lax.scan` over the new-token axis, so the whole generation is a
+  single compiled program (one dispatch), not a per-token Python loop.
+- attention over the cache is a masked dense read of the full cache —
+  at decode the query is one token, so the (1, max_len) score row is
+  tiny; masking `kpos > pos` makes the static shape exact.
+- the same `NeuralNet` (core/net.py) drives decode: position-wise
+  layers (embed, rmsnorm, ffn, moe, residual) run their normal
+  `apply`; only kAttention (cache read/write + absolute-position RoPE)
+  and the heads (emit logits instead of loss) are special-cased.
+
+Works with both head forms emitted by models.transformer.transformer_lm
+(kLMHead -> kSoftmaxLoss, and the fused kLMHeadLoss whose loss layer is
+re-used here only for its projection weight).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.layers import Context
+from ..core.net import NeuralNet
+
+CacheEntry = Dict[str, jnp.ndarray]   # {"k","v"}: (B, Hkv, max_len, D)
+Cache = Dict[str, CacheEntry]         # attention-layer name -> entry
+
+
+def init_cache(net: NeuralNet, batchsize: int, max_len: int,
+               dtype=jnp.float32) -> Cache:
+    """Zeroed KV cache for every kAttention layer in the net."""
+    cache: Cache = {}
+    for name in net.topo:
+        layer = net.layers[name]
+        if layer.cfg.type != "kAttention":
+            continue
+        shape = (batchsize, layer.kv_heads, max_len, layer.head_dim)
+        cache[name] = {"k": jnp.zeros(shape, dtype),
+                      "v": jnp.zeros(shape, dtype)}
+    return cache
+
+
+def _attn_cached(layer, params, x, entry: CacheEntry, pos
+                 ) -> Tuple[jnp.ndarray, CacheEntry]:
+    """Attention for a (B, T, E) chunk whose first token sits at absolute
+    position `pos` (traced scalar), against the running KV cache."""
+    from ..ops.attention import expand_kv_heads, rope
+
+    assert layer.causal, f"{layer.name}: decode requires causal attention"
+    b, t, e = x.shape
+    q = layer._proj(params, layer.wq, x, _CTX).reshape(
+        b, t, layer.heads, layer.head_dim).transpose(0, 2, 1, 3)
+    k = layer._proj(params, layer.wk, x, _CTX).reshape(
+        b, t, layer.kv_heads, layer.head_dim).transpose(0, 2, 1, 3)
+    v = layer._proj(params, layer.wv, x, _CTX).reshape(
+        b, t, layer.kv_heads, layer.head_dim).transpose(0, 2, 1, 3)
+    if layer.use_rope:
+        qpos = pos + jnp.arange(t)
+        q = rope(q, qpos, layer.rope_theta)
+        k = rope(k, qpos, layer.rope_theta)
+
+    k_cache = jax.lax.dynamic_update_slice(
+        entry["k"], k.astype(entry["k"].dtype), (0, 0, pos, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        entry["v"], v.astype(entry["v"].dtype), (0, 0, pos, 0))
+
+    kk = expand_kv_heads(k_cache, layer.heads).astype(q.dtype)
+    vv = expand_kv_heads(v_cache, layer.heads).astype(q.dtype)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, kk,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(layer.head_dim))
+    qpos = pos + jnp.arange(t)[:, None]            # (T, 1) absolute
+    kpos = jnp.arange(kk.shape[2])[None, :]        # (1, max_len)
+    scores = jnp.where((kpos <= qpos)[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vv.dtype), vv)
+    out = out.transpose(0, 2, 1, 3).reshape(b, t, -1)
+    out = layer._proj(params, layer.wo, out.astype(x.dtype), _CTX)
+    return out, {"k": k_cache, "v": v_cache}
+
+
+_CTX = Context(batch={}, train=False, rng=None, layer_index=0, mesh=None,
+               compute_dtype=None)
+
+
+def forward_cached(net: NeuralNet, params, tokens: jnp.ndarray,
+                   cache: Cache, pos) -> Tuple[jnp.ndarray, Cache]:
+    """Run the LM over a (B, T) token chunk at absolute offset `pos`.
+    Returns (logits (B, T, V) float32, updated cache)."""
+    full = net._resolve_params(params)
+    outputs: Dict[str, Any] = {}
+    new_cache: Cache = dict(cache)
+    logits = None
+    for idx, name in enumerate(net.topo):
+        layer = net.layers[name]
+        ltype = layer.cfg.type
+        srcs = [net._src_out(outputs, s, name) for s in layer.cfg.srclayers]
+        if ltype == "kSequenceData":
+            outputs[name] = {"input": tokens, "target": tokens}
+        elif ltype == "kSeqLabel":
+            outputs[name] = tokens
+        elif ltype == "kAttention":
+            out, new_cache[name] = _attn_cached(
+                layer, full, srcs[0], cache[name], pos)
+            outputs[name] = out
+        elif ltype == "kLMHead":
+            outputs[name] = layer.apply(full, srcs, _CTX)
+            logits = outputs[name]
+        elif ltype == "kLMHeadLoss":
+            # reuse the fused loss layer's projection to emit logits
+            w = full[layer.w_key]
+            if layer.tied:
+                w = w.T
+            logits = jnp.einsum("bse,ev->bsv", srcs[0], w,
+                                preferred_element_type=jnp.float32)
+            outputs[name] = logits
+        elif ltype == "kSoftmaxLoss":
+            outputs[name] = None     # no loss at decode
+        else:
+            ctx = Context(batch={}, train=False, rng=None, layer_index=idx,
+                          mesh=None, compute_dtype=None)
+            outputs[name] = layer.apply(full, srcs, ctx)
+    if logits is None:
+        raise ValueError("net has no kLMHead/kLMHeadLoss layer")
+    return logits.astype(jnp.float32), new_cache
+
+
+def _sample(logits: jnp.ndarray, key, temperature: float,
+            top_k: int) -> jnp.ndarray:
+    """logits: (B, V) -> (B,) int32.  temperature 0 = greedy."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0 and top_k < logits.shape[-1]:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnums=(0, 3, 5, 6, 7))
+def _generate_jit(net, params, prompt, max_new_tokens, key,
+                  temperature, top_k, eos_id):
+    b, p = prompt.shape
+    max_len = p + max_new_tokens
+    dtype = jax.tree_util.tree_leaves(params)[0].dtype
+    cache = init_cache(net, b, max_len, dtype)
+
+    logits, cache = forward_cached(net, params, prompt, cache, 0)
+    keys = jax.random.split(key, max_new_tokens)
+    tok0 = _sample(logits[:, -1], keys[0], temperature, top_k)
+    done0 = (jnp.zeros((b,), jnp.bool_) if eos_id is None
+             else tok0 == eos_id)
+
+    def step(carry, k):
+        tok, cache, pos, done = carry
+        logits, cache = forward_cached(net, params, tok[:, None], cache, pos)
+        nxt = _sample(logits[:, -1], k, temperature, top_k)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        return (nxt, cache, pos + 1, done), nxt
+
+    (_, _, _, _), rest = jax.lax.scan(
+        step, (tok0, cache, jnp.int32(p), done0), keys[1:])
+    return jnp.concatenate([tok0[:, None], rest.T], axis=1)
+
+
+def generate(net: NeuralNet, params, prompt,
+             max_new_tokens: int, key: Optional[jax.Array] = None,
+             temperature: float = 0.0, top_k: int = 0,
+             eos_id: Optional[int] = None) -> jnp.ndarray:
+    """Sample `max_new_tokens` continuations of `prompt` ((B, P) int32).
+    Returns the (B, max_new_tokens) generated tokens.  One compiled
+    program: prefill + a lax.scan decode loop with per-step sampling
+    (greedy when temperature == 0; top-k truncation when top_k > 0).
+    After `eos_id` is produced, a sequence keeps emitting `eos_id`."""
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    prompt = jnp.asarray(prompt, jnp.int32)
+    return _generate_jit(net, params, prompt, int(max_new_tokens), key,
+                         float(temperature), int(top_k), eos_id)

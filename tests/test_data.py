@@ -279,3 +279,59 @@ def test_native_record_batch_decode_matches_python(tmp_path):
     batches = list(shard_batches(str(folder), 3, loop=False))
     assert [b["data"]["pixel"].shape[0] for b in batches] == [3, 3, 1]
     np.testing.assert_array_equal(batches[0]["data"]["pixel"][0], recs[0][0])
+
+
+def test_native_decode_rejects_mixed_shapes():
+    """Same pixel count but different dims must NOT be silently
+    reinterpreted under record 0's shape (native == Python semantics)."""
+    native = pytest.importorskip("singa_tpu.data.native")
+    if not native.available():
+        pytest.skip("native library not built")
+    px = bytes(range(60))
+    a = Record(image=SingleLabelImageRecord(
+        shape=[3, 5, 4], label=0, pixel=px)).encode()
+    b = Record(image=SingleLabelImageRecord(
+        shape=[60], label=1, pixel=px)).encode()
+    assert native.decode_image_batch([a, a]) is not None
+    assert native.decode_image_batch([a, b]) is None   # falls back
+
+
+def test_native_decode_skips_unknown_fixed_fields():
+    """Records carrying unknown fixed32/fixed64 fields still decode on
+    the native path (find_image must skip wire types 1 and 5)."""
+    native = pytest.importorskip("singa_tpu.data.native")
+    if not native.available():
+        pytest.skip("native library not built")
+    import struct
+    px = bytes(range(12))
+    body = Record(image=SingleLabelImageRecord(
+        shape=[3, 4], label=2, pixel=px)).encode()
+    # prepend unknown field 15 (fixed64) and field 14 (fixed32)
+    extra = bytes([(15 << 3) | 1]) + struct.pack("<Q", 7)
+    extra += bytes([(14 << 3) | 5]) + struct.pack("<I", 9)
+    out = native.decode_image_batch([extra + body])
+    assert out is not None
+    pixels, labels = out
+    assert pixels.shape == (1, 3, 4) and labels[0] == 2
+
+
+def test_pipeline_skips_imageless_records(tmp_path):
+    """Type-only records (no image submessage) never shrink a batch."""
+    from singa_tpu.data.pipeline import shard_batches
+
+    rng = np.random.default_rng(3)
+    folder = tmp_path / "s"
+    os.makedirs(folder)
+    with Shard(str(folder), Shard.KCREATE) as sh:
+        n = 0
+        for i in range(9):
+            if i % 3 == 1:
+                sh.insert(f"t{i}", Record(type=1).encode())  # image-less
+            else:
+                img = rng.integers(0, 256, (2, 2)).astype(np.uint8)
+                sh.insert(f"k{i}", Record(image=SingleLabelImageRecord(
+                    shape=[2, 2], label=i, pixel=img.tobytes())).encode())
+                n += 1
+    batches = list(shard_batches(str(folder), 2, loop=False))
+    sizes = [b["data"]["pixel"].shape[0] for b in batches]
+    assert sum(sizes) == n and all(s == 2 for s in sizes[:-1])
