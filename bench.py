@@ -1,5 +1,5 @@
-"""Benchmark: MNIST LeNet (reference examples/mnist/conv.conf) training
-throughput on the available accelerator.
+"""Benchmark: MNIST LeNet (examples/mnist/conv.conf, identical to the
+reference's conv.conf) training throughput on the available accelerator.
 
 Prints ONE JSON line on stdout: {"metric", "value", "unit",
 "vs_baseline"}.  Secondary metrics (AlexNet/CIFAR-10 MFU — north-star
@@ -16,6 +16,7 @@ scale its 2015-era CPU cluster sweep targeted).
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -51,7 +52,9 @@ def bench_lenet():
     from singa_tpu.config import load_model_config
     from singa_tpu.core.trainer import Trainer
 
-    cfg = load_model_config("/root/reference/examples/mnist/conv.conf")
+    cfg = load_model_config(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "examples/mnist/conv.conf"))
     for layer in cfg.neuralnet.layer:
         if layer.data_param:
             layer.data_param.batchsize = BATCH

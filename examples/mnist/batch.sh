@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Benchmark sweep — successor of the reference's batch.sh nworkers x
+# nservers x nthreads grid.  On TPU the sweep axes are batch size and
+# precision; one JSON line per run is appended to sweep.jsonl.
+set -e
+cd "$(dirname "$0")/../.."
+exec bash examples/sweep.sh "$@"

@@ -455,6 +455,34 @@ def model_config_from_dict(d: Dict[str, Any]) -> ModelConfig:
     return _build(ModelConfig, _listify(d), "<dict>")
 
 
+def config_to_dict(cfg) -> Dict[str, Any]:
+    """Dataclass config → nested {field: value} dict, omitting fields that
+    still hold their schema default (the loader re-fills them), so the
+    emitted text-proto stays as terse as the reference's hand-written
+    configs (examples/mnist/*.conf)."""
+    out: Dict[str, Any] = {}
+    for f in dataclasses.fields(type(cfg)):
+        v = getattr(cfg, f.name)
+        if f.default is not dataclasses.MISSING and v == f.default:
+            continue
+        if f.default_factory is not dataclasses.MISSING and v == f.default_factory():  # noqa: E501
+            continue
+        if dataclasses.is_dataclass(v):
+            out[f.name] = config_to_dict(v)
+        elif isinstance(v, list):
+            out[f.name] = [config_to_dict(x) if dataclasses.is_dataclass(x)
+                           else x for x in v]
+        else:
+            out[f.name] = v
+    return out
+
+
+def model_config_to_text(cfg: "ModelConfig") -> str:
+    """Serialize back to the reference's text-proto surface; round-trips
+    through load (`model_config_from_text(model_config_to_text(c)) == c`)."""
+    return textproto.dump(config_to_dict(cfg)) + "\n"
+
+
 def _listify(d: Dict[str, Any]) -> Dict[str, List[Any]]:
     out: Dict[str, List[Any]] = {}
     for k, v in d.items():

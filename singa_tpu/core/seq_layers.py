@@ -168,8 +168,11 @@ class AttentionLayer(Layer):
         return jnp.einsum("bse,ed->bsd", x, w,
                           preferred_element_type=jnp.float32).astype(x.dtype)
 
-    def apply(self, params, srcs, ctx):
-        x = srcs[0]
+    def qkv(self, params, x, positions, ctx):
+        """Projection + head-split + RoPE prologue, shared by training
+        `apply` and the KV-cache decode path (models/generate.py).
+        `positions`: (S,) absolute token positions for RoPE.  Returns
+        q (B, H, S, D) and k, v (B, Hkv, S, D) — pre-GQA-expansion."""
         b, s, e = x.shape
         q = self._proj(params, self.wq, x, ctx).reshape(
             b, s, self.heads, self.head_dim).transpose(0, 2, 1, 3)
@@ -178,9 +181,14 @@ class AttentionLayer(Layer):
         v = self._proj(params, self.wv, x, ctx).reshape(
             b, s, self.kv_heads, self.head_dim).transpose(0, 2, 1, 3)
         if self.use_rope:
-            pos = jnp.arange(s)
-            q = rope(q, pos, self.rope_theta)
-            k = rope(k, pos, self.rope_theta)
+            q = rope(q, positions, self.rope_theta)
+            k = rope(k, positions, self.rope_theta)
+        return q, k, v
+
+    def apply(self, params, srcs, ctx):
+        x = srcs[0]
+        b, s, e = x.shape
+        q, k, v = self.qkv(params, x, jnp.arange(s), ctx)
         k = expand_kv_heads(k, self.heads)
         v = expand_kv_heads(v, self.heads)
 
@@ -298,8 +306,29 @@ class ResidualAddLayer(Layer):
         return srcs[0] + srcs[1]
 
 
+class _HeadProjection:
+    """Shared (E, V) projection for the LM head layers — the single
+    definition of the tied-transpose + compute-dtype semantics, used by
+    training (`apply`) and the KV-cache decode path (models/generate.py)
+    alike."""
+
+    def head_weight(self, params, compute_dtype=None):
+        w = params[self.w_key]
+        if self.tied:
+            w = w.T
+        if compute_dtype is not None:
+            w = w.astype(compute_dtype)
+        return w
+
+    def project_logits(self, params, hidden, compute_dtype=None):
+        """(B, S, E) hidden → (B, S, V) float32 logits."""
+        return jnp.einsum("bse,ev->bsv", hidden,
+                          self.head_weight(params, compute_dtype),
+                          preferred_element_type=jnp.float32)
+
+
 @register_layer("kLMHead")
-class LMHeadLayer(Layer):
+class LMHeadLayer(Layer, _HeadProjection):
     """(B, S, E) → (B, S, V) logits; optionally tied to the embedding via
     share_param."""
 
@@ -317,17 +346,11 @@ class LMHeadLayer(Layer):
             self, 0, "w", (e, p.vocab_size), 1.0 / math.sqrt(e), 1)
 
     def apply(self, params, srcs, ctx):
-        w = params[self.w_key]
-        if self.tied:
-            w = w.T
-        if ctx.compute_dtype is not None:
-            w = w.astype(ctx.compute_dtype)
-        return jnp.einsum("bse,ev->bsv", srcs[0], w,
-                          preferred_element_type=jnp.float32)
+        return self.project_logits(params, srcs[0], ctx.compute_dtype)
 
 
 @register_layer("kLMHeadLoss")
-class LMHeadLossLayer(Layer):
+class LMHeadLossLayer(Layer, _HeadProjection):
     """Fused LM head + softmax-xent + top-k precision: (B, S, E) hidden
     + (B, S) labels → metrics, WITHOUT materializing (B, S, V) logits
     (ops.loss.chunked_lm_xent: chunked scan, checkpointed recompute in
@@ -354,11 +377,7 @@ class LMHeadLossLayer(Layer):
     def apply(self, params, srcs, ctx):
         from ..ops.loss import chunked_lm_xent
         hidden, labels = srcs
-        w = params[self.w_key]
-        if self.tied:
-            w = w.T
-        if ctx.compute_dtype is not None:
-            w = w.astype(ctx.compute_dtype)
+        w = self.head_weight(params, ctx.compute_dtype)
         b, s, e = hidden.shape
         loss, prec = chunked_lm_xent(
             hidden.reshape(b * s, e), w, labels.reshape(-1),

@@ -74,6 +74,7 @@ class Prefetcher:
     def __init__(self, it: Iterator, depth: int = 2):
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._it = it
+        self._err: Optional[BaseException] = None
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -81,7 +82,9 @@ class Prefetcher:
         try:
             for item in self._it:
                 self._q.put(item)
-        finally:
+        except BaseException as e:  # re-raised on the consumer thread —
+            self._err = e           # a corrupt record must not look like
+        finally:                    # a clean end of data
             self._q.put(self._END)
 
     def __iter__(self):
@@ -90,6 +93,8 @@ class Prefetcher:
     def __next__(self):
         item = self._q.get()
         if item is self._END:
+            if self._err is not None:
+                raise self._err
             raise StopIteration
         return item
 

@@ -160,13 +160,19 @@ class Record:
 def record_has_image(buf: bytes) -> bool:
     """Whether a serialized Record carries an image submessage — a
     tag-walk only (no submessage parse), cheap enough for the input
-    pipeline to filter image-less records before batching."""
+    pipeline to filter image-less records before batching.
+
+    Raises ValueError on an unparseable buffer: a torn/corrupt record
+    must fail loudly (the shard store already truncates torn tails at
+    open, shard.cc:175-206 semantics), not be silently dropped as if it
+    were merely image-less."""
     try:
         for fn, wt, _ in _iter_fields(buf):
             if fn == 2 and wt == _WT_LEN:
                 return True
-    except (ValueError, IndexError):
-        return False
+    except (ValueError, IndexError) as e:
+        raise ValueError(
+            f"corrupt Record buffer ({len(buf)} bytes): {e}") from e
     return False
 
 

@@ -55,20 +55,11 @@ def _attn_cached(layer, params, x, entry: CacheEntry, pos
                  ) -> Tuple[jnp.ndarray, CacheEntry]:
     """Attention for a (B, T, E) chunk whose first token sits at absolute
     position `pos` (traced scalar), against the running KV cache."""
-    from ..ops.attention import expand_kv_heads, rope
+    from ..ops.attention import expand_kv_heads
 
     assert layer.causal, f"{layer.name}: decode requires causal attention"
     b, t, e = x.shape
-    q = layer._proj(params, layer.wq, x, _CTX).reshape(
-        b, t, layer.heads, layer.head_dim).transpose(0, 2, 1, 3)
-    k = layer._proj(params, layer.wk, x, _CTX).reshape(
-        b, t, layer.kv_heads, layer.head_dim).transpose(0, 2, 1, 3)
-    v = layer._proj(params, layer.wv, x, _CTX).reshape(
-        b, t, layer.kv_heads, layer.head_dim).transpose(0, 2, 1, 3)
-    if layer.use_rope:
-        qpos = pos + jnp.arange(t)
-        q = rope(q, qpos, layer.rope_theta)
-        k = rope(k, qpos, layer.rope_theta)
+    q, k, v = layer.qkv(params, x, pos + jnp.arange(t), _CTX)
 
     k_cache = jax.lax.dynamic_update_slice(
         entry["k"], k.astype(entry["k"].dtype), (0, 0, pos, 0))
@@ -119,11 +110,7 @@ def forward_cached(net: NeuralNet, params, tokens: jnp.ndarray,
             logits = outputs[name]
         elif ltype == "kLMHeadLoss":
             # reuse the fused loss layer's projection to emit logits
-            w = full[layer.w_key]
-            if layer.tied:
-                w = w.T
-            logits = jnp.einsum("bse,ev->bsv", srcs[0], w,
-                                preferred_element_type=jnp.float32)
+            logits = layer.project_logits(full, srcs[0])
             outputs[name] = logits
         elif ltype == "kSoftmaxLoss":
             outputs[name] = None     # no loss at decode
@@ -143,7 +130,7 @@ def _sample(logits: jnp.ndarray, key, temperature: float,
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     if top_k > 0 and top_k < logits.shape[-1]:
-        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
         logits = jnp.where(logits < kth, -1e30, logits)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
@@ -188,5 +175,7 @@ def generate(net: NeuralNet, params, prompt,
     if key is None:
         key = jax.random.PRNGKey(0)
     prompt = jnp.asarray(prompt, jnp.int32)
+    if int(max_new_tokens) <= 0:
+        return jnp.zeros((prompt.shape[0], 0), jnp.int32)
     return _generate_jit(net, params, prompt, int(max_new_tokens), key,
                          float(temperature), int(top_k), eos_id)

@@ -1,4 +1,5 @@
-from .activations import relu, sigmoid, tanh, stanh, softplus, bnll
+from .activations import (relu, sigmoid, tanh, stanh, softplus, bnll,
+                          square, threshold, power, sqrtop)
 from .conv import conv2d, im2col, conv_out_size
 from .pool import max_pool2d, avg_pool2d, pooled_size
 from .lrn import lrn

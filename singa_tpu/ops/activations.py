@@ -47,3 +47,26 @@ def bnll(x):
     """Binomial negative log-likelihood, cxxnet_op.h:58-62 (caffe BNLL):
     x>0 ? x + log(1+exp(-x)) : log(1+exp(x)) — the stable softplus."""
     return jax.nn.softplus(x)
+
+
+def square(x):
+    """cxxnet_op.h:71-75."""
+    return x * x
+
+
+def threshold(a, b):
+    """Bernoulli mask: 1.0 where a < b else 0.0 (cxxnet_op.h:96-101).
+    The reference applies it to uniform samples to build dropout masks
+    (layer.cc:137-141)."""
+    return jnp.where(a < b, 1.0, 0.0).astype(jnp.result_type(a))
+
+
+def power(a, b):
+    """Elementwise a**b (cxxnet_op.h:103-108)."""
+    return jnp.power(a, b)
+
+
+def sqrtop(a, b):
+    """sqrt(a + b) — the AdaDelta/RMS denominator helper
+    (cxxnet_op.h:109-113)."""
+    return jnp.sqrt(a + b)

@@ -1,0 +1,43 @@
+"""Emit the example .conf files from the programmatic model zoo.
+
+The reference ships hand-written text-proto configs under
+examples/mnist/ (mlp.conf, conv.conf — reference examples/mnist/); here
+the same configs are *generated* from `singa_tpu.models.vision` so the
+zoo and the on-disk examples can never drift.  Run after changing the
+zoo:
+
+    python -m singa_tpu.tools.export_examples [--outdir examples]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+from singa_tpu.config import model_config_to_text
+from singa_tpu.models import vision
+
+
+EXAMPLES = {
+    "mnist/mlp.conf": lambda: vision.mlp_mnist(),
+    "mnist/conv.conf": lambda: vision.lenet_mnist(),
+    "cifar10/quick.conf": lambda: vision.alexnet_cifar10(),
+    "cifar10/alexnet.conf": lambda: vision.alexnet_cifar10_full(),
+    "imagenet/alexnet.conf": lambda: vision.alexnet_imagenet(),
+}
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--outdir", default="examples")
+    args = ap.parse_args(argv)
+    for rel, build in EXAMPLES.items():
+        path = os.path.join(args.outdir, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(model_config_to_text(build()))
+        print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

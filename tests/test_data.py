@@ -315,6 +315,30 @@ def test_native_decode_skips_unknown_fixed_fields():
     assert pixels.shape == (1, 3, 4) and labels[0] == 2
 
 
+def test_prefetcher_propagates_iterator_errors():
+    """A corrupt record must surface as an error on the consumer thread,
+    not masquerade as a clean end of data."""
+    from singa_tpu.data.pipeline import prefetch
+
+    def bad_iter():
+        yield 1
+        raise ValueError("corrupt Record buffer")
+
+    it = prefetch(bad_iter())
+    assert next(it) == 1
+    with pytest.raises(ValueError, match="corrupt Record buffer"):
+        next(it)
+
+
+def test_corrupt_record_raises():
+    from singa_tpu.data.records import record_has_image
+
+    good = Record(type=1).encode()
+    assert record_has_image(good) is False
+    with pytest.raises(ValueError, match="corrupt"):
+        record_has_image(b"\x12\xff")  # length-delimited field, torn tail
+
+
 def test_pipeline_skips_imageless_records(tmp_path):
     """Type-only records (no image submessage) never shrink a batch."""
     from singa_tpu.data.pipeline import shard_batches

@@ -67,6 +67,41 @@ def test_cli_runs_example_end_to_end():
     assert "mesh: " in out.stdout and "training done" in out.stdout
 
 
+def test_shipped_example_confs_match_zoo_and_reference():
+    """examples/{mnist,cifar10,imagenet}/*.conf are generated from the
+    model zoo (tools/export_examples); they must load back equal to the
+    zoo configs, and the mnist pair must describe the same nets as the
+    reference's hand-written mlp.conf/conv.conf."""
+    from singa_tpu.models import vision
+    from singa_tpu.tools.export_examples import EXAMPLES
+
+    for rel, build in EXAMPLES.items():
+        assert load_model_config(f"examples/{rel}") == build(), rel
+
+    ours = load_model_config("examples/mnist/conv.conf")
+    ref = load_model_config("/root/reference/examples/mnist/conv.conf")
+    # data source differs by design (kShardData here vs the reference's
+    # phase-excluded kLMDBData pair); the neuron-layer graph must match.
+    skip = {"kShardData", "kLMDBData"}
+    assert ([(l.name, l.type) for l in ours.neuralnet.layer
+             if l.type not in skip]
+            == [(l.name, l.type) for l in ref.neuralnet.layer
+                if l.type not in skip])
+    assert ours.updater.base_learning_rate == ref.updater.base_learning_rate
+
+    mlp_ours = load_model_config("examples/mnist/mlp.conf")
+    mlp_ref = load_model_config("/root/reference/examples/mnist/mlp.conf")
+    assert ([(l.type,
+              l.inner_product_param.num_output if l.inner_product_param
+              else None) for l in mlp_ours.neuralnet.layer
+             if l.type not in skip]
+            == [(l.type,
+                 l.inner_product_param.num_output if l.inner_product_param
+                 else None) for l in mlp_ref.neuralnet.layer
+                if l.type not in skip])
+    assert vision.mlp_mnist() == mlp_ours
+
+
 def test_viz_dot_and_log_plot(tmp_path):
     """tools/viz: net JSON -> dot (script/graph.py role) and training-log
     -> curves (script/draw.py role)."""

@@ -41,11 +41,7 @@ def _full_logits(net, params, toks):
                if l.cfg.type == "kLMHeadLoss"]
     layer = net.layers[name]
     hidden = outputs[layer.cfg.srclayers[0]]
-    w = net._resolve_params(params)[layer.w_key]
-    if layer.tied:
-        w = w.T
-    return jnp.einsum("bse,ev->bsv", hidden, w,
-                      preferred_element_type=jnp.float32)
+    return layer.project_logits(net._resolve_params(params), hidden)
 
 
 @pytest.mark.parametrize("fused_head", [False, True])
@@ -124,6 +120,13 @@ def test_generate_sampling_topk_and_eos():
         hits = np.where(row == eos)[0]
         if hits.size:
             assert (row[hits[0]:] == eos).all()
+
+
+def test_generate_zero_tokens_returns_empty():
+    net, params = _net_and_params(fused_head=True)
+    prompt = jnp.zeros((B, 4), jnp.int32)
+    out = generate(net, params, prompt, 0)
+    assert out.shape == (B, 0) and out.dtype == jnp.int32
 
 
 def test_generate_with_moe_and_gqa():

@@ -147,6 +147,22 @@ def test_stanh_constants():
     np.testing.assert_allclose(np.asarray(g), want, rtol=1e-5)
 
 
+def test_binary_op_structs():
+    """square/threshold/power/sqrtop vs cxxnet_op.h:71-113 oracles."""
+    a = jnp.array([0.25, 4.0, 0.5, 2.0])
+    b = jnp.array([0.5, 0.5, 3.0, 2.0])
+    np.testing.assert_allclose(np.asarray(ops.square(a)),
+                               np.asarray(a) ** 2, rtol=1e-6)
+    np.testing.assert_array_equal(
+        np.asarray(ops.threshold(a, b)),
+        (np.asarray(a) < np.asarray(b)).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(ops.power(a, b)),
+                               np.asarray(a) ** np.asarray(b), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(ops.sqrtop(a, b)),
+                               np.sqrt(np.asarray(a) + np.asarray(b)),
+                               rtol=1e-6)
+
+
 def test_relu_and_leaky():
     x = jnp.array([-2.0, 0.0, 3.0])
     np.testing.assert_allclose(np.asarray(ops.relu(x)), [0, 0, 3])
