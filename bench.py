@@ -80,7 +80,7 @@ def bench_lenet():
     }))
 
 
-def bench_alexnet_mfu(batch_size=1024, precision="bfloat16"):
+def bench_alexnet_mfu(batch_size=2048, precision="bfloat16"):
     """North-star gate 2: AlexNet/CIFAR-10 at >=50% MFU (BASELINE.md).
 
     Measured on the actual 5-conv AlexNet stack adapted to 32x32

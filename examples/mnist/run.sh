@@ -6,11 +6,11 @@
 # Multi-host (one invocation per host, like the reference's -procsID=$i):
 #   ./run.sh conv -hostfile hostfile -procsID $i
 #
-# Uses --synthetic when no shard data exists at the config's data path;
-# build real shards with `python -m singa_tpu.tools.loader create mnist`.
+# Falls back to synthetic data automatically when no shard data exists at
+# the config's data path; build real shards with
+# `python -m singa_tpu.tools.loader create mnist`.
 set -e
 cd "$(dirname "$0")/../.."
 MODEL="${1:-conv}"
 shift || true
-exec python -m singa_tpu.main -model_conf "examples/mnist/${MODEL}.conf" \
-    --synthetic "$@"
+exec python -m singa_tpu.main -model_conf "examples/mnist/${MODEL}.conf" "$@"

@@ -174,30 +174,39 @@ class MnistImageLayer(Layer):
         if self.distort_on and ctx.train:
             from ..ops.augment import elastic_deform
             x = elastic_deform(x, ctx.layer_rng(), **self.distort)
-        return x / self.norm_a - self.norm_b
+        x = x / self.norm_a - self.norm_b
+        if ctx.compute_dtype is not None:
+            x = x.astype(ctx.compute_dtype)
+        return x
 
 
 @register_layer("kRGBImage")
 class RGBImageLayer(Layer):
     """Parser (layer.cc:571-643): mean-subtract, random crop + mirror in
-    training / center crop in eval, scale. Output (B, 3, crop, crop)."""
+    training / center crop in eval, scale.
+
+    Host batches arrive channels-first ((B, 3, H, W), the Record pixel
+    layout); the parser transposes once to NHWC — the layout the whole
+    vision stack runs in on TPU (channels on the 128-lane axis; see
+    ops/conv.py).  Output (B, crop, crop, 3)."""
 
     def setup(self, src_shapes):
         p = self.cfg.rgbimage_param
         self.scale = p.scale if p else 1.0
         self.cropsize = p.cropsize if p else 0
         self.mirror = bool(p.mirror) if p else False
-        shape = list(src_shapes[0]["pixel"])  # (B, C, H, W)
+        b, c, h, w = src_shapes[0]["pixel"]  # (B, C, H, W) host layout
         if self.cropsize:
-            shape[2] = shape[3] = self.cropsize
-        self.out_shape = tuple(shape)
+            h = w = self.cropsize
+        self.out_shape = (b, h, w, c)
 
     def apply(self, params, srcs, ctx):
         x = srcs[0]["pixel"].astype(jnp.float32)
         mean = srcs[0].get("mean")
         if mean is not None:
             x = x - mean
-        b, c, h, w = x.shape
+        x = x.transpose(0, 2, 3, 1)  # → NHWC
+        b, h, w, c = x.shape
         cs = self.cropsize
         if cs and (h > cs or w > cs):
             if ctx.train:
@@ -205,14 +214,17 @@ class RGBImageLayer(Layer):
                 r1, r2, r3 = jax.random.split(rng, 3)
                 oh = jax.random.randint(r1, (), 0, h - cs + 1)
                 ow = jax.random.randint(r2, (), 0, w - cs + 1)
-                x = jax.lax.dynamic_slice(x, (0, 0, oh, ow), (b, c, cs, cs))
+                x = jax.lax.dynamic_slice(x, (0, oh, ow, 0), (b, cs, cs, c))
                 if self.mirror:
                     flip = jax.random.bernoulli(r3)
-                    x = jnp.where(flip, x[..., ::-1], x)
+                    x = jnp.where(flip, x[:, :, ::-1], x)
             else:
                 oh, ow = (h - cs) // 2, (w - cs) // 2
-                x = x[:, :, oh:oh + cs, ow:ow + cs]
-        return x * self.scale
+                x = x[:, oh:oh + cs, ow:ow + cs]
+        x = x * self.scale
+        if ctx.compute_dtype is not None:
+            x = x.astype(ctx.compute_dtype)
+        return x
 
 
 @register_layer("kLabel")
@@ -230,17 +242,17 @@ class LabelLayer(Layer):
 # neuron layers
 
 
-def _nchw_shape(shape):
-    """Reference conv/pool accept 3-D (B,H,W) inputs as single-channel
-    (layer.cc:31-36)."""
+def _nhwc_shape(shape):
+    """Vision activations run NHWC on TPU.  Reference conv/pool accept
+    3-D (B,H,W) inputs as single-channel (layer.cc:31-36) → (B,H,W,1)."""
     if len(shape) == 3:
-        return (shape[0], 1, shape[1], shape[2])
+        return (shape[0], shape[1], shape[2], 1)
     return tuple(shape)
 
 
-def _as_nchw(x):
+def _as_nhwc(x):
     if x.ndim == 3:
-        return x.reshape(x.shape[0], 1, x.shape[1], x.shape[2])
+        return x.reshape(x.shape[0], x.shape[1], x.shape[2], 1)
     return x
 
 
@@ -253,14 +265,14 @@ class ConvolutionLayer(Layer):
         p = self.cfg.convolution_param
         if p is None or not p.kernel:
             raise LayerError(f"{self.name}: convolution_param.kernel required")
-        b, c, h, w = _nchw_shape(src_shapes[0])
+        b, h, w, c = _nhwc_shape(src_shapes[0])
         self.channels, self.height, self.width = c, h, w
         self.kernel, self.stride, self.pad = p.kernel, p.stride, p.pad
         self.num_filters = p.num_filters
         self.bias_term = p.bias_term
         ch = ops.conv_out_size(h, p.kernel, p.stride, p.pad)
         cw = ops.conv_out_size(w, p.kernel, p.stride, p.pad)
-        self.out_shape = (b, p.num_filters, ch, cw)
+        self.out_shape = (b, ch, cw, p.num_filters)
         col_height = c * p.kernel * p.kernel
         self.w_key = self._declare(0, "weight", (p.num_filters, col_height),
                                    fan_in=col_height, partition_dim=0)
@@ -269,11 +281,11 @@ class ConvolutionLayer(Layer):
                                        partition_dim=0)
 
     def apply(self, params, srcs, ctx):
-        x = _as_nchw(srcs[0])
+        x = _as_nhwc(srcs[0])
         bias = params[self.b_key] if self.bias_term else None
         return ops.conv2d(x, params[self.w_key], bias, kernel=self.kernel,
                           stride=self.stride, pad=self.pad,
-                          channels=self.channels)
+                          channels=self.channels, layout="NHWC")
 
 
 @register_layer("kPooling")
@@ -284,16 +296,16 @@ class PoolingLayer(Layer):
             raise LayerError(f"{self.name}: pooling_param.kernel required")
         if p.pool not in ("MAX", "AVE"):
             raise LayerError(f"{self.name}: bad pool method {p.pool!r}")
-        b, c, h, w = _nchw_shape(src_shapes[0])
+        b, h, w, c = _nhwc_shape(src_shapes[0])
         self.kernel, self.stride, self.mode = p.kernel, p.stride, p.pool
-        self.out_shape = (b, c, ops.pooled_size(h, p.kernel, p.stride),
-                          ops.pooled_size(w, p.kernel, p.stride))
+        self.out_shape = (b, ops.pooled_size(h, p.kernel, p.stride),
+                          ops.pooled_size(w, p.kernel, p.stride), c)
 
     def apply(self, params, srcs, ctx):
-        x = _as_nchw(srcs[0])
+        x = _as_nhwc(srcs[0])
         if self.mode == "MAX":
-            return ops.max_pool2d(x, self.kernel, self.stride)
-        return ops.avg_pool2d(x, self.kernel, self.stride)
+            return ops.max_pool2d(x, self.kernel, self.stride, layout="NHWC")
+        return ops.avg_pool2d(x, self.kernel, self.stride, layout="NHWC")
 
 
 @register_layer("kLRN")
@@ -310,14 +322,16 @@ class LRNLayer(Layer):
 
     def apply(self, params, srcs, ctx):
         return ops.lrn(srcs[0], self.local_size, self.alpha, self.beta,
-                       self.knorm)
+                       self.knorm, layout="NHWC")
 
 
 @register_layer("kInnerProduct")
 class InnerProductLayer(Layer):
     """layer.cc:162-213: flatten to (B, vdim), weight (vdim, hdim).
     NOTE the reference passes fan_in = vdim*hdim to Param::Setup
-    (layer.cc:174) — reproduced for init parity."""
+    (layer.cc:174) — reproduced for init parity.  vdim element order
+    follows the NHWC runtime layout (H, W, C) rather than the
+    reference's (C, H, W); weight shape and numerics are unaffected."""
 
     def setup(self, src_shapes):
         p = self.cfg.inner_product_param

@@ -28,10 +28,13 @@ from .updater import Multipliers
 class NeuralNet:
     def __init__(self, net_cfg: NetConfig, phase: str = "kTrain",
                  input_shapes: Optional[Dict[str, Dict[str, tuple]]] = None,
-                 batchsize: Optional[int] = None):
+                 batchsize: Optional[int] = None, remat: bool = True):
         """input_shapes: data-layer name → field → per-sample shape
         (no batch dim), e.g. {"data": {"pixel": (28, 28), "label": ()}}.
         `batchsize` overrides DataProto.batchsize for all data layers.
+        `remat`: rematerialize cheap bandwidth-bound layers (LRN) in the
+        backward instead of saving their f32 intermediates — numerics
+        unchanged; disabled under ModelProto.debug.
         """
         self.phase = phase
         self.cfgs: List[LayerConfig] = [
@@ -56,6 +59,7 @@ class NeuralNet:
             l.name: create_layer(l) for l in self.cfgs}
         self._setup()
         self._build_param_index()
+        self.remat_types = {"kLRN"} if remat else set()
 
     # -- construction ------------------------------------------------------
     def _setup(self) -> None:
@@ -159,7 +163,12 @@ class NeuralNet:
             ctx = Context(batch=ctx_batch, train=train, rng=rng,
                           layer_index=idx, mesh=mesh,
                           compute_dtype=compute_dtype)
-            out = layer.apply(full, srcs, ctx)
+            if layer.cfg.type in self.remat_types:
+                out = jax.checkpoint(
+                    lambda *s, _l=layer, _c=ctx: _l.apply(full, list(s), _c)
+                )(*srcs)
+            else:
+                out = layer.apply(full, srcs, ctx)
             outputs[name] = out
             aux = getattr(layer, "_aux", None)
             if aux is not None:
@@ -207,4 +216,5 @@ def build_net(model_cfg: ModelConfig, phase: str = "kTrain",
               input_shapes=None, batchsize=None) -> NeuralNet:
     if model_cfg.neuralnet is None:
         raise LayerError("model config has no neuralnet section")
-    return NeuralNet(model_cfg.neuralnet, phase, input_shapes, batchsize)
+    return NeuralNet(model_cfg.neuralnet, phase, input_shapes, batchsize,
+                     remat=not model_cfg.debug)

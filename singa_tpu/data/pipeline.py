@@ -75,6 +75,7 @@ class Prefetcher:
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._it = it
         self._err: Optional[BaseException] = None
+        self._done = False
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -91,11 +92,14 @@ class Prefetcher:
         return self
 
     def __next__(self):
-        item = self._q.get()
-        if item is self._END:
+        if self._done:           # terminal: never block on the dead queue
             if self._err is not None:
                 raise self._err
             raise StopIteration
+        item = self._q.get()
+        if item is self._END:
+            self._done = True
+            return self.__next__()
         return item
 
 

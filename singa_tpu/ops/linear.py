@@ -12,9 +12,12 @@ import jax.numpy as jnp
 
 
 def linear(x: jnp.ndarray, weight: jnp.ndarray, bias=None) -> jnp.ndarray:
-    """x: (B, ...) flattened to (B, vdim); weight: (vdim, hdim)."""
+    """x: (B, ...) flattened to (B, vdim); weight: (vdim, hdim).  The gemm
+    runs in x's dtype (bf16 under mixed precision) with f32 MXU
+    accumulation; output returns to x's dtype."""
     x = x.reshape(x.shape[0], -1)
-    y = jnp.dot(x, weight, preferred_element_type=jnp.float32)
+    y = jnp.dot(x, weight.astype(x.dtype),
+                preferred_element_type=jnp.float32)
     if bias is not None:
-        y = y + bias
-    return y
+        y = y + bias.astype(jnp.float32)
+    return y.astype(x.dtype)

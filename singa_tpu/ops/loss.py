@@ -19,8 +19,9 @@ import jax.numpy as jnp
 
 def softmax_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
                           scale: float = 1.0) -> jnp.ndarray:
-    """logits: (B, D) float; labels: (B,) int. Returns scalar mean NLL*scale."""
-    logits = logits.reshape(logits.shape[0], -1)
+    """logits: (B, D) float; labels: (B,) int. Returns scalar mean NLL*scale.
+    Computed in f32 regardless of the logits dtype (bf16 nets included)."""
+    logits = logits.reshape(logits.shape[0], -1).astype(jnp.float32)
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     label_logit = jnp.take_along_axis(
         logits, labels.astype(jnp.int32)[:, None], axis=-1)[:, 0]

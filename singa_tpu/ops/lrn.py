@@ -8,8 +8,10 @@ channel (zero-padded).  Backward is derived by autodiff; the reference's
 hand-written gradient (layer.cc:366-377) is the exact derivative of this
 forward, so the numerics match.
 
-On TPU: a windowed sum over the channel axis — one `lax.reduce_window`
-that XLA fuses with the surrounding elementwise ops.
+On TPU (NHWC path): the channel-window sum is a banded-matrix matmul on
+the MXU — see `lrn` — because a lane-axis reduce_window costs
+activation-sized relayout passes.  The NCHW path keeps reduce_window
+and serves as the golden-test oracle.
 """
 
 from __future__ import annotations
@@ -18,21 +20,42 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _band(c: int, local_size: int) -> jnp.ndarray:
+    """(C, C) 0/1 banded matrix: band[i, j] = |i - j| <= local_size//2."""
+    idx = jnp.arange(c)
+    return (jnp.abs(idx[:, None] - idx[None, :])
+            <= local_size // 2).astype(jnp.float32)
+
+
 def lrn(x: jnp.ndarray, local_size: int = 5, alpha: float = 1.0,
-        beta: float = 0.75, knorm: float = 1.0) -> jnp.ndarray:
-    """x: (N, C, H, W) cross-channel LRN."""
+        beta: float = 0.75, knorm: float = 1.0,
+        layout: str = "NCHW") -> jnp.ndarray:
+    """Cross-channel LRN; x (N, C, H, W) or (N, H, W, C) per layout.
+
+    NHWC path: the channel-window sum is a matmul against a (C, C)
+    banded 0/1 matrix — it rides the (otherwise idle) MXU instead of a
+    lane-axis reduce_window, which on TPU costs activation-sized
+    relayout passes.  Its autodiff backward is the transposed banded
+    matmul, equally cheap."""
     half = local_size // 2
-    sq = x * x
-    norm = lax.reduce_window(
-        sq, 0.0, lax.add,
-        window_dimensions=(1, local_size, 1, 1),
-        window_strides=(1, 1, 1, 1),
-        padding=((0, 0), (half, half), (0, 0), (0, 0)))
+    if layout == "NHWC":
+        # window sum in x's dtype (bf16 under mixed precision: halves the
+        # HBM traffic of the sq/norm tensors; the MXU still accumulates
+        # the ≤local_size bf16 squares in f32, and the result only
+        # normalizes — ~0.4% relative error is inconsequential there)
+        sq = jnp.square(x)
+        norm = jnp.dot(sq, _band(x.shape[-1], local_size).astype(x.dtype),
+                       preferred_element_type=jnp.float32)
+    else:
+        sq = jnp.square(x.astype(jnp.float32))
+        dims = (1, local_size, 1, 1)
+        pad = ((0, 0), (half, half), (0, 0), (0, 0))
+        norm = lax.reduce_window(sq, 0.0, lax.add, dims, (1, 1, 1, 1), pad)
     norm = norm * (alpha / local_size) + knorm
     if beta == 0.75:
         # norm^-3/4 == rsqrt(norm)*sqrt(rsqrt(norm)): sqrt/rsqrt are
         # single VPU ops, vs pow = exp∘log transcendentals which
         # measured as expensive as the windowed sum itself.
         r = lax.rsqrt(norm)
-        return x * (r * jnp.sqrt(r))
-    return x * (norm ** -beta)
+        return (x.astype(jnp.float32) * (r * jnp.sqrt(r))).astype(x.dtype)
+    return (x.astype(jnp.float32) * (norm ** -beta)).astype(x.dtype)

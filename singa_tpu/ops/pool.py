@@ -25,24 +25,36 @@ def _ceil_pad(size: int, kernel: int, stride: int) -> int:
     return max(0, (out - 1) * stride + kernel - size)
 
 
-def max_pool2d(x: jnp.ndarray, kernel: int, stride: int) -> jnp.ndarray:
-    """x: (N, C, H, W). Ceil-mode max pool."""
-    n, c, h, w = x.shape
+def _window(kernel, stride, ph, pw, layout):
+    if layout == "NHWC":
+        return ((1, kernel, kernel, 1), (1, stride, stride, 1),
+                ((0, 0), (0, ph), (0, pw), (0, 0)))
+    return ((1, 1, kernel, kernel), (1, 1, stride, stride),
+            ((0, 0), (0, 0), (0, ph), (0, pw)))
+
+
+def _spatial(x, layout):
+    return (x.shape[2], x.shape[3]) if layout == "NCHW" else (
+        x.shape[1], x.shape[2])
+
+
+def max_pool2d(x: jnp.ndarray, kernel: int, stride: int,
+               layout: str = "NCHW") -> jnp.ndarray:
+    """Ceil-mode max pool; x (N, C, H, W) or (N, H, W, C) per layout."""
+    h, w = _spatial(x, layout)
     ph, pw = _ceil_pad(h, kernel, stride), _ceil_pad(w, kernel, stride)
-    return lax.reduce_window(
-        x, -jnp.inf, lax.max,
-        window_dimensions=(1, 1, kernel, kernel),
-        window_strides=(1, 1, stride, stride),
-        padding=((0, 0), (0, 0), (0, ph), (0, pw)))
+    dims, strides, pad = _window(kernel, stride, ph, pw, layout)
+    # NOTE: init must be a weak-typed Python scalar — an Array init value
+    # defeats reduce_window's autodiff rule.
+    return lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pad)
 
 
-def avg_pool2d(x: jnp.ndarray, kernel: int, stride: int) -> jnp.ndarray:
+def avg_pool2d(x: jnp.ndarray, kernel: int, stride: int,
+               layout: str = "NCHW") -> jnp.ndarray:
     """Ceil-mode average pool dividing by k*k always (layer.cc:513-515)."""
-    n, c, h, w = x.shape
+    h, w = _spatial(x, layout)
     ph, pw = _ceil_pad(h, kernel, stride), _ceil_pad(w, kernel, stride)
-    s = lax.reduce_window(
-        x, 0.0, lax.add,
-        window_dimensions=(1, 1, kernel, kernel),
-        window_strides=(1, 1, stride, stride),
-        padding=((0, 0), (0, 0), (0, ph), (0, pw)))
-    return s * (1.0 / (kernel * kernel))
+    dims, strides, pad = _window(kernel, stride, ph, pw, layout)
+    s = lax.reduce_window(x.astype(jnp.float32), 0.0, lax.add, dims, strides,
+                          pad)
+    return (s * (1.0 / (kernel * kernel))).astype(x.dtype)

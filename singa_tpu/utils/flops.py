@@ -68,7 +68,7 @@ def mfu(model_flops: float, step_seconds: float,
 # -- analytic per-layer counts (forward, 2·MACs convention) ----------------
 
 def _conv_flops(layer) -> int:
-    n, c_out, h, w = layer.out_shape
+    n, h, w, c_out = layer.out_shape  # NHWC
     return 2 * n * c_out * h * w * layer.kernel ** 2 * layer.channels
 
 

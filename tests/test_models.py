@@ -32,9 +32,9 @@ def test_alexnet_cifar10_builds_and_steps():
     cfg = alexnet_cifar10(batchsize=8, train_steps=2)
     trainer = Trainer(cfg, CIFAR_SHAPES, donate=False)
     net = trainer.train_net
-    assert net.shapes["conv1"] == (8, 32, 32, 32)
-    assert net.shapes["pool1"] == (8, 32, 16, 16)
-    assert net.shapes["pool3"] == (8, 64, 4, 4)
+    assert net.shapes["conv1"] == (8, 32, 32, 32)  # NHWC (h=w=c=32)
+    assert net.shapes["pool1"] == (8, 16, 16, 32)
+    assert net.shapes["pool3"] == (8, 4, 4, 64)
     assert net.shapes["ip1"] == (8, 10)
     params, opt = trainer.init(0)
     p, o, m = trainer.train_step(params, opt, _cifar_batch(8), 0,
@@ -47,9 +47,9 @@ def test_alexnet_imagenet_shapes():
     shapes = {"data": {"pixel": (3, 256, 256), "label": ()}}
     trainer = Trainer(cfg, shapes, donate=False)
     net = trainer.train_net
-    assert net.shapes["rgb"] == (2, 3, 227, 227)
-    assert net.shapes["conv1"] == (2, 96, 55, 55)
-    assert net.shapes["pool5"] == (2, 256, 6, 6)
+    assert net.shapes["rgb"] == (2, 227, 227, 3)  # NHWC
+    assert net.shapes["conv1"] == (2, 55, 55, 96)
+    assert net.shapes["pool5"] == (2, 6, 6, 256)
     assert net.shapes["fc6"] == (2, 4096)
     assert net.shapes["fc8"] == (2, 100)
 

@@ -57,10 +57,11 @@ def test_build_mlp_from_reference_conf():
 def test_build_lenet_from_reference_conf():
     cfg = load_model_config("/root/reference/examples/mnist/conv.conf")
     net = build_net(cfg, "kTrain", MNIST_SHAPES, batchsize=4)
-    assert net.shapes["conv1"] == (4, 20, 24, 24)
-    assert net.shapes["pool1"] == (4, 20, 12, 12)
-    assert net.shapes["conv2"] == (4, 50, 8, 8)
-    assert net.shapes["pool2"] == (4, 50, 4, 4)
+    # NHWC runtime layout (same geometry as the reference's NCHW shapes)
+    assert net.shapes["conv1"] == (4, 24, 24, 20)
+    assert net.shapes["pool1"] == (4, 12, 12, 20)
+    assert net.shapes["conv2"] == (4, 8, 8, 50)
+    assert net.shapes["pool2"] == (4, 4, 4, 50)
     assert net.shapes["ip1"] == (4, 500)
     assert net.shapes["ip2"] == (4, 10)
     assert net.param_specs["conv1/weight"].shape == (20, 25)

@@ -147,6 +147,42 @@ def test_stanh_constants():
     np.testing.assert_allclose(np.asarray(g), want, rtol=1e-5)
 
 
+def test_nhwc_ops_match_nchw_oracles():
+    """The NHWC code paths (the production layout for every vision net)
+    must agree numerically with the NCHW golden-oracle paths: conv's
+    HWIO weight transpose, pool's window tuples, and LRN's banded-matmul
+    channel window."""
+    x = RNG.standard_normal((2, 5, 7, 7)).astype(np.float32)  # NCHW
+    xh = jnp.asarray(np.moveaxis(x, 1, -1))                   # NHWC
+    xc = jnp.asarray(x)
+
+    w = RNG.standard_normal((6, 5 * 3 * 3)).astype(np.float32)
+    b = RNG.standard_normal((6,)).astype(np.float32)
+    conv_c = ops.conv2d(xc, jnp.asarray(w), jnp.asarray(b), kernel=3,
+                        stride=2, pad=1)
+    conv_h = ops.conv2d(xh, jnp.asarray(w), jnp.asarray(b), kernel=3,
+                        stride=2, pad=1, layout="NHWC")
+    np.testing.assert_allclose(np.moveaxis(np.asarray(conv_h), -1, 1),
+                               np.asarray(conv_c), rtol=1e-5, atol=1e-5)
+
+    for f in (ops.max_pool2d, ops.avg_pool2d):
+        pc = f(xc, 3, 2)
+        ph = f(xh, 3, 2, layout="NHWC")
+        np.testing.assert_allclose(np.moveaxis(np.asarray(ph), -1, 1),
+                                   np.asarray(pc), rtol=1e-6)
+
+    lc = ops.lrn(xc, 3, 5e-5, 0.75, 1.0)
+    lh = ops.lrn(xh, 3, 5e-5, 0.75, 1.0, layout="NHWC")
+    np.testing.assert_allclose(np.moveaxis(np.asarray(lh), -1, 1),
+                               np.asarray(lc), rtol=1e-5, atol=1e-6)
+    # gradients too (banded matmul backward vs reduce_window backward)
+    gc = jax.grad(lambda t: (ops.lrn(t, 3, 5e-5, 0.75, 1.0) ** 2).sum())(xc)
+    gh = jax.grad(lambda t: (ops.lrn(t, 3, 5e-5, 0.75, 1.0,
+                                     layout="NHWC") ** 2).sum())(xh)
+    np.testing.assert_allclose(np.moveaxis(np.asarray(gh), -1, 1),
+                               np.asarray(gc), rtol=1e-4, atol=1e-5)
+
+
 def test_binary_op_structs():
     """square/threshold/power/sqrtop vs cxxnet_op.h:71-113 oracles."""
     a = jnp.array([0.25, 4.0, 0.5, 2.0])
