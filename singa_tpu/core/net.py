@@ -28,13 +28,17 @@ from .updater import Multipliers
 class NeuralNet:
     def __init__(self, net_cfg: NetConfig, phase: str = "kTrain",
                  input_shapes: Optional[Dict[str, Dict[str, tuple]]] = None,
-                 batchsize: Optional[int] = None, remat: bool = True):
+                 batchsize: Optional[int] = None):
         """input_shapes: data-layer name → field → per-sample shape
         (no batch dim), e.g. {"data": {"pixel": (28, 28), "label": ()}}.
         `batchsize` overrides DataProto.batchsize for all data layers.
-        `remat`: rematerialize cheap bandwidth-bound layers (LRN) in the
-        backward instead of saving their f32 intermediates — numerics
-        unchanged; disabled under ModelProto.debug.
+
+        `remat_types` (attribute): layer type strings to wrap in
+        jax.checkpoint — an opt-in knob for memory-tight stacks.  Empty
+        by default: LRN, the one type it used to list, now carries a
+        hand-written custom_vjp (ops/lrn.py) whose residuals are cheaper
+        than the remat recompute was (autodiff through checkpoint built
+        bitpacked-mask fusion soup costing ~10% of the AlexNet step).
         """
         self.phase = phase
         self.cfgs: List[LayerConfig] = [
@@ -59,7 +63,7 @@ class NeuralNet:
             l.name: create_layer(l) for l in self.cfgs}
         self._setup()
         self._build_param_index()
-        self.remat_types = {"kLRN"} if remat else set()
+        self.remat_types: set = set()
 
     # -- construction ------------------------------------------------------
     def _setup(self) -> None:
@@ -216,5 +220,4 @@ def build_net(model_cfg: ModelConfig, phase: str = "kTrain",
               input_shapes=None, batchsize=None) -> NeuralNet:
     if model_cfg.neuralnet is None:
         raise LayerError("model config has no neuralnet section")
-    return NeuralNet(model_cfg.neuralnet, phase, input_shapes, batchsize,
-                     remat=not model_cfg.debug)
+    return NeuralNet(model_cfg.neuralnet, phase, input_shapes, batchsize)

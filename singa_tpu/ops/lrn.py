@@ -4,58 +4,116 @@ Reference: layer.cc:331-378 —
     norm = chpool_sum(x^2, lsize) * (alpha/lsize) + knorm
     y    = x * norm^(-beta)
 where chpool sums x^2 over a channel window of lsize centered at each
-channel (zero-padded).  Backward is derived by autodiff; the reference's
-hand-written gradient (layer.cc:366-377) is the exact derivative of this
-forward, so the numerics match.
+channel (zero-padded).  The reference's hand-written gradient
+(layer.cc:366-377) is the exact derivative of this forward, so the
+numerics match.
 
 On TPU (NHWC path): the channel-window sum is a banded-matrix matmul on
-the MXU — see `lrn` — because a lane-axis reduce_window costs
-activation-sized relayout passes.  The NCHW path keeps reduce_window
-and serves as the golden-test oracle.
+the MXU — a lane-axis reduce_window costs activation-sized relayout
+passes, and a lane-shift add chain measured ~12% slower end-to-end on
+the AlexNet stack.  The whole chain runs in the compute dtype.  Under
+bf16 that rounds the window sum, norm, and n^-β to ~0.4% relative —
+the same order as the unavoidable final bf16 rounding of y = x·n^-β
+itself, so the achievable accuracy is output-resolution-bound either
+way (in the caffe-alpha regime n = 1 + O(1e-4), bf16 rounds n^-β to
+exactly 1 — but so does the bf16 cast of y = x·(1 - O(1e-4))).  An
+f32 norm/pow chain measured 1.7-3ms/step slower at batch 2048 (f32
+intermediates/residuals cost real HBM) for accuracy the output dtype
+then discards.  The f32 NCHW oracle below is exact, and the golden
+tests compare the two paths in f32, where they agree to 1e-6.
+
+The backward is a hand-written custom_vjp (the same closed form the
+reference derives): letting XLA autodiff through the band matmul under
+jax.checkpoint generated bitpacked-relu-mask + f32-recompute fusion
+soup that cost ~10% of the whole AlexNet train step.  Residuals are
+(x, s); n and n^-β are recomputed from s in the backward (register
+ops, no extra HBM pass).
+
+The NCHW path keeps reduce_window + autodiff and serves as the
+golden-test oracle.
 """
 
 from __future__ import annotations
 
+import functools
+
+import jax
 import jax.numpy as jnp
 from jax import lax
 
 
-def _band(c: int, local_size: int) -> jnp.ndarray:
+def _band(c: int, local_size: int, dtype) -> jnp.ndarray:
     """(C, C) 0/1 banded matrix: band[i, j] = |i - j| <= local_size//2."""
     idx = jnp.arange(c)
     return (jnp.abs(idx[:, None] - idx[None, :])
-            <= local_size // 2).astype(jnp.float32)
+            <= local_size // 2).astype(dtype)
+
+
+def _pow_neg_beta(n: jnp.ndarray, beta: float) -> jnp.ndarray:
+    if beta == 0.75:
+        # norm^-3/4 == rsqrt(norm)*sqrt(rsqrt(norm)): sqrt/rsqrt are
+        # single VPU ops, vs pow = exp∘log transcendentals which
+        # measured as expensive as the windowed sum itself.
+        r = lax.rsqrt(n)
+        return r * jnp.sqrt(r)
+    return n ** -beta
+
+
+def _window_sum(x: jnp.ndarray, local_size: int) -> jnp.ndarray:
+    """Channel-window sum of x² in x's dtype; partial sums accumulate
+    in f32 (requested explicitly — free under fusion) and only the
+    final s rounds to the compute dtype."""
+    sq = jnp.square(x)
+    return jnp.dot(sq, _band(x.shape[-1], local_size, x.dtype),
+                   preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def _p_of_s(s: jnp.ndarray, local_size: int, alpha: float, beta: float,
+            knorm: float):
+    """(n, n^-β) in the compute dtype from the window sum."""
+    n = s * jnp.asarray(alpha / local_size, s.dtype) + jnp.asarray(
+        knorm, s.dtype)
+    return n, _pow_neg_beta(n, beta)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _lrn_nhwc(x, local_size, alpha, beta, knorm):
+    return _lrn_nhwc_fwd(x, local_size, alpha, beta, knorm)[0]
+
+
+def _lrn_nhwc_fwd(x, local_size, alpha, beta, knorm):
+    s = _window_sum(x, local_size)
+    _, p = _p_of_s(s, local_size, alpha, beta, knorm)
+    return x * p, (x, s)
+
+
+def _lrn_nhwc_bwd(local_size, alpha, beta, knorm, res, g):
+    # d/dx of y_i = x_i·n_i^-β with n = k + (α/L)·B(x²):
+    #   dx = g·n^-β − 2β(α/L)·x·Bᵀ(g·x·n^{-β-1})
+    # (B symmetric, so Bᵀ = B); matches the reference's closed form
+    # (layer.cc:366-377).
+    x, s = res
+    n, p = _p_of_s(s, local_size, alpha, beta, knorm)
+    t = g * x * (p / n)                     # g·x·n^{-β-1}
+    u = jnp.dot(t, _band(x.shape[-1], local_size, x.dtype))
+    dx = g * p - jnp.asarray(
+        2 * beta * alpha / local_size, x.dtype) * x * u
+    return (dx,)
+
+
+_lrn_nhwc.defvjp(_lrn_nhwc_fwd, _lrn_nhwc_bwd)
 
 
 def lrn(x: jnp.ndarray, local_size: int = 5, alpha: float = 1.0,
         beta: float = 0.75, knorm: float = 1.0,
         layout: str = "NCHW") -> jnp.ndarray:
-    """Cross-channel LRN; x (N, C, H, W) or (N, H, W, C) per layout.
-
-    NHWC path: the channel-window sum is a matmul against a (C, C)
-    banded 0/1 matrix — it rides the (otherwise idle) MXU instead of a
-    lane-axis reduce_window, which on TPU costs activation-sized
-    relayout passes.  Its autodiff backward is the transposed banded
-    matmul, equally cheap."""
-    half = local_size // 2
+    """Cross-channel LRN; x (N, C, H, W) or (N, H, W, C) per layout."""
     if layout == "NHWC":
-        # window sum in x's dtype (bf16 under mixed precision: halves the
-        # HBM traffic of the sq/norm tensors; the MXU still accumulates
-        # the ≤local_size bf16 squares in f32, and the result only
-        # normalizes — ~0.4% relative error is inconsequential there)
-        sq = jnp.square(x)
-        norm = jnp.dot(sq, _band(x.shape[-1], local_size).astype(x.dtype),
-                       preferred_element_type=jnp.float32)
-    else:
-        sq = jnp.square(x.astype(jnp.float32))
-        dims = (1, local_size, 1, 1)
-        pad = ((0, 0), (half, half), (0, 0), (0, 0))
-        norm = lax.reduce_window(sq, 0.0, lax.add, dims, (1, 1, 1, 1), pad)
+        return _lrn_nhwc(x, local_size, alpha, beta, knorm)
+    half = local_size // 2
+    sq = jnp.square(x.astype(jnp.float32))
+    dims = (1, local_size, 1, 1)
+    pad = ((0, 0), (half, half), (0, 0), (0, 0))
+    norm = lax.reduce_window(sq, 0.0, lax.add, dims, (1, 1, 1, 1), pad)
     norm = norm * (alpha / local_size) + knorm
-    if beta == 0.75:
-        # norm^-3/4 == rsqrt(norm)*sqrt(rsqrt(norm)): sqrt/rsqrt are
-        # single VPU ops, vs pow = exp∘log transcendentals which
-        # measured as expensive as the windowed sum itself.
-        r = lax.rsqrt(norm)
-        return (x.astype(jnp.float32) * (r * jnp.sqrt(r))).astype(x.dtype)
-    return (x.astype(jnp.float32) * (norm ** -beta)).astype(x.dtype)
+    return (x.astype(jnp.float32) * _pow_neg_beta(norm, beta)).astype(x.dtype)
