@@ -1,0 +1,111 @@
+import json, sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+
+from singa_tpu.core.trainer import Trainer
+from singa_tpu.models.vision import alexnet_cifar10_full
+from singa_tpu.utils.flops import mfu, net_train_flops
+from singa_tpu.utils.profiler import hard_sync
+
+BS, ITERS = 2048, 20
+
+def rewire(layers, removed):
+    """Drop layers named in `removed`, rewiring consumers to their src."""
+    alias = {}
+    out = []
+    for l in layers:
+        src = l.get("srclayers")
+        if isinstance(src, str): src = [src]
+        if src: l["srclayers"] = [alias.get(s, s) for s in src]
+        if l["name"] in removed:
+            alias[l["name"]] = l["srclayers"][0]
+            # propagate chained aliases
+            alias[l["name"]] = alias.get(alias[l["name"]], alias[l["name"]])
+        else:
+            out.append(l)
+    return out
+
+def build(mod):
+    import singa_tpu.models.vision as V
+    from singa_tpu.config.schema import model_config_from_dict
+    cfg = alexnet_cifar10_full(batchsize=BS)
+    d = None
+    # easier: rebuild from the builder fns by patching layer dicts
+    layers = []
+    # reconstruct dict list via the module's private builders
+    h = V._data_head(BS, "kRGBImage", rgb_scale=1/255.0)
+    layers, head = h
+    body = [
+        V._conv("conv1", head, 64, 5, 1, 2, std=1e-2),
+        V._relu("relu1", "conv1"),
+        V._lrn("norm1", "relu1", 5, 1e-4),
+        V._pool("pool1", "norm1", 3, 2, "AVE" if mod=="avgpool" else "MAX"),
+        V._conv("conv2", "pool1", 192, 5, 1, 2, std=1e-2, bias_value=1.0),
+        V._relu("relu2", "conv2"),
+        V._lrn("norm2", "relu2", 5, 1e-4),
+        V._pool("pool2", "norm2", 3, 2, "AVE" if mod=="avgpool" else "MAX"),
+        V._conv("conv3", "pool2", 384, 3, 1, 1, std=1e-2),
+        V._relu("relu3", "conv3"),
+        V._conv("conv4", "relu3", 256, 3, 1, 1, std=1e-2, bias_value=1.0),
+        V._relu("relu4", "conv4"),
+        V._conv("conv5", "relu4", 256, 3, 1, 1, std=1e-2, bias_value=1.0),
+        V._relu("relu5", "conv5"),
+        V._pool("pool5", "relu5", 3, 2, "AVE" if mod=="avgpool" else "MAX"),
+        V._ip("fc6", "pool5", 4096, std=5e-3, bias_value=1.0),
+        V._relu("relu6", "fc6"),
+        V._dropout("drop6", "relu6"),
+        V._ip("fc7", "drop6", 4096, std=5e-3, bias_value=1.0),
+        V._relu("relu7", "fc7"),
+        V._dropout("drop7", "relu7"),
+        V._ip("fc8", "drop7", 10, std=1e-2),
+        V._loss("fc8"),
+    ]
+    layers += body
+    removed = set()
+    if mod == "nolrn": removed = {"norm1", "norm2"}
+    elif mod == "nodrop": removed = {"drop6", "drop7"}
+    elif mod == "norelu": removed = {f"relu{i}" for i in range(1,8)}
+    elif mod == "nolrn_nodrop": removed = {"norm1","norm2","drop6","drop7"}
+    layers = rewire(layers, removed)
+    return model_config_from_dict({
+        "name": f"alexnet-abl-{mod}", "train_steps": 100,
+        "display_frequency": 100,
+        "updater": {"type": "kSGD", "base_learning_rate": 0.01,
+                    "momentum": 0.9, "weight_decay": 0.0005,
+                    "learning_rate_change_method": "kFixed"},
+        "neuralnet": {"layer": layers},
+    })
+
+def timeit(cfg, fwd_only=False):
+    cfg.precision = "bfloat16"
+    shapes = {"data": {"pixel": (3, 32, 32), "label": ()}}
+    tr = Trainer(cfg, shapes, log_fn=lambda s: None)
+    params, opt_state = tr.init(seed=0)
+    rng = np.random.default_rng(0)
+    batch = {"data": {
+        "pixel": jax.device_put(rng.standard_normal((BS,3,32,32)).astype(np.float32)),
+        "label": jax.device_put(rng.integers(0,10,(BS,)).astype(np.int32))}}
+    key = jax.random.PRNGKey(0)
+    if fwd_only:
+        import functools
+        f = jax.jit(lambda p, b, k: tr.train_net.apply(p, b, rng=k, train=True)[0])
+        out = f(params, batch, key); hard_sync(out)
+        t0 = time.perf_counter()
+        for _ in range(ITERS): out = f(params, batch, key)
+        hard_sync(out)
+        return (time.perf_counter()-t0)/ITERS, tr
+    params, opt_state, _ = tr.train_steps(params, opt_state, batch, 0, key, ITERS)
+    hard_sync(params)
+    t0 = time.perf_counter()
+    params, opt_state, _ = tr.train_steps(params, opt_state, batch, ITERS, key, ITERS)
+    hard_sync(params)
+    return (time.perf_counter()-t0)/ITERS, tr
+
+base_flops = None
+for mod in ["base", "fwdonly", "nolrn", "avgpool", "nodrop", "nolrn_nodrop"]:
+    cfg = build("base" if mod in ("base","fwdonly") else mod)
+    s, tr = timeit(cfg, fwd_only=(mod=="fwdonly"))
+    fl = net_train_flops(tr.train_net)
+    print(json.dumps({"mod": mod, "step_ms": round(s*1e3,3),
+                      "mfu_vs_full": round(mfu(3.1211e12, s) or 0, 4)}))

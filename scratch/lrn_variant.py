@@ -1,0 +1,62 @@
+import json, sys, time, functools, os
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+import singa_tpu.ops as ops
+import singa_tpu.core.layers as L
+import importlib
+lm = importlib.import_module('singa_tpu.ops.lrn')
+
+# variant: residual x only, recompute s in bwd
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,2,3,4,5))
+def lrn_v(x, local_size, alpha, beta, knorm, relu):
+    return lm._lrn_nhwc_fwd(x, local_size, alpha, beta, knorm, relu)[0]
+def _fwd(x, local_size, alpha, beta, knorm, relu):
+    a = jnp.maximum(x, jnp.zeros((), x.dtype)) if relu else x
+    s = lm._window_sum(a, local_size)
+    _, p = lm._p_of_s(s, local_size, alpha, beta, knorm)
+    return a * p, x
+def _bwd(local_size, alpha, beta, knorm, relu, x, g):
+    a = jnp.maximum(x, jnp.zeros((), x.dtype)) if relu else x
+    s = lm._window_sum(a, local_size)
+    n, p = lm._p_of_s(s, local_size, alpha, beta, knorm)
+    t = g * a * (p / n)
+    u = jnp.dot(t, lm._band(x.shape[-1], local_size, x.dtype))
+    da = g * p - jnp.asarray(2*beta*alpha/local_size, x.dtype) * a * u
+    if relu:
+        da = jnp.where(x > 0, da, jnp.zeros((), da.dtype))
+    return (da,)
+lrn_v.defvjp(_fwd, _bwd)
+
+def relu_lrn_v(x, local_size=5, alpha=1.0, beta=0.75, knorm=1.0, relu=False, layout="NHWC"):
+    if layout == "NHWC":
+        return lrn_v(x, local_size, alpha, beta, knorm, relu)
+    a = jnp.maximum(x, jnp.zeros((), x.dtype)) if relu else x
+    return lm.lrn(a, local_size, alpha, beta, knorm, layout)
+
+ops.relu_lrn = L.ops.relu_lrn = relu_lrn_v
+
+from singa_tpu.core.trainer import Trainer
+from singa_tpu.models.vision import alexnet_cifar10_full
+from singa_tpu.utils.flops import mfu, net_train_flops
+from singa_tpu.utils.profiler import hard_sync
+BS, ITERS = 2048, 20
+cfg = alexnet_cifar10_full(batchsize=BS); cfg.precision = "bfloat16"
+tr = Trainer(cfg, {"data": {"pixel": (3,32,32), "label": ()}}, log_fn=lambda s: None)
+params, opt_state = tr.init(seed=0)
+rng = np.random.default_rng(0)
+batch = {"data": {"pixel": jax.device_put(rng.standard_normal((BS,3,32,32)).astype(np.float32)),
+                  "label": jax.device_put(rng.integers(0,10,(BS,)).astype(np.int32))}}
+key = jax.random.PRNGKey(0)
+params, opt_state, _ = tr.train_steps(params, opt_state, batch, 0, key, ITERS)
+hard_sync(params)
+ts = []
+for r in range(6):
+    t0 = time.perf_counter()
+    params, opt_state, _ = tr.train_steps(params, opt_state, batch, ITERS, key, ITERS)
+    hard_sync(params)
+    ts.append((time.perf_counter()-t0)/ITERS)
+fl = net_train_flops(tr.train_net)
+best = min(ts)
+print(json.dumps({"variant": "recompute_s", "best_ms": round(best*1e3,3),
+                  "mfu": round(mfu(fl, best) or 0, 4)}))

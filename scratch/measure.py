@@ -1,0 +1,35 @@
+import json, sys, time, os
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+from singa_tpu.core.trainer import Trainer
+from singa_tpu.models.vision import alexnet_cifar10_full
+from singa_tpu.utils.flops import mfu, net_train_flops
+from singa_tpu.utils.profiler import hard_sync
+
+BS = int(os.environ.get("BS", 2048))
+ITERS = int(os.environ.get("ITERS", 20))
+REPS = int(os.environ.get("REPS", 6))
+cfg = alexnet_cifar10_full(batchsize=BS)
+cfg.precision = "bfloat16"
+tr = Trainer(cfg, {"data": {"pixel": (3,32,32), "label": ()}}, log_fn=lambda s: None)
+params, opt_state = tr.init(seed=0)
+rng = np.random.default_rng(0)
+batch = {"data": {
+    "pixel": jax.device_put(rng.standard_normal((BS,3,32,32)).astype(np.float32)),
+    "label": jax.device_put(rng.integers(0,10,(BS,)).astype(np.int32))}}
+key = jax.random.PRNGKey(0)
+params, opt_state, _ = tr.train_steps(params, opt_state, batch, 0, key, ITERS)
+hard_sync(params)
+ts = []
+for r in range(REPS):
+    t0 = time.perf_counter()
+    params, opt_state, _ = tr.train_steps(params, opt_state, batch, ITERS, key, ITERS)
+    hard_sync(params)
+    ts.append((time.perf_counter()-t0)/ITERS)
+fl = net_train_flops(tr.train_net)
+best, med = min(ts), sorted(ts)[len(ts)//2]
+print(json.dumps({"best_ms": round(best*1e3,3), "med_ms": round(med*1e3,3),
+                  "mfu_best": round(mfu(fl, best) or 0, 4),
+                  "mfu_med": round(mfu(fl, med) or 0, 4),
+                  "all": [round(t*1e3,2) for t in ts]}))

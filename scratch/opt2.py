@@ -1,0 +1,141 @@
+import json, sys, time, functools
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+
+from singa_tpu.core.trainer import Trainer
+from singa_tpu.models.vision import alexnet_cifar10_full
+from singa_tpu.utils.flops import mfu
+from singa_tpu.utils.profiler import hard_sync
+import singa_tpu.ops as ops
+import singa_tpu.ops.pool as pool_mod
+import singa_tpu.core.layers as L
+
+BS, ITERS = 2048, 20
+MODEL_TFLOPS = 3.1211e12
+
+# ---- LRN custom_vjp, minimal residual (save x only), all-bf16 ----
+def _band(c, local_size, dtype):
+    idx = jnp.arange(c)
+    return (jnp.abs(idx[:, None] - idx[None, :]) <= local_size // 2).astype(dtype)
+
+def _norm(x, local_size, alpha, knorm):
+    sq = jnp.square(x)
+    n = jnp.dot(sq, _band(x.shape[-1], local_size, x.dtype))
+    return n * jnp.asarray(alpha/local_size, x.dtype) + jnp.asarray(knorm, x.dtype)
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,2,3,4,5))
+def lrn2(x, local_size=5, alpha=1.0, beta=0.75, knorm=1.0, layout="NCHW"):
+    n = _norm(x, local_size, alpha, knorm)
+    r = lax.rsqrt(n)
+    return x * (r * jnp.sqrt(r))
+
+def _lrn2_fwd(x, local_size, alpha, beta, knorm, layout):
+    return lrn2(x, local_size, alpha, beta, knorm, layout), x
+
+def _lrn2_bwd(local_size, alpha, beta, knorm, layout, x, g):
+    n = _norm(x, local_size, alpha, knorm)
+    r = lax.rsqrt(n)          # n^-1/2
+    p = r * jnp.sqrt(r)       # n^-3/4
+    t = g * x * (p * r * r)   # g*x*n^-7/4
+    s = jnp.dot(t, _band(x.shape[-1], local_size, x.dtype))
+    dx = g * p - jnp.asarray(2*beta*alpha/local_size, x.dtype) * x * s
+    return (dx,)
+lrn2.defvjp(_lrn2_fwd, _lrn2_bwd)
+
+def lrn_dispatch(x, local_size=5, alpha=1.0, beta=0.75, knorm=1.0, layout="NCHW"):
+    import importlib; lm = importlib.import_module('singa_tpu.ops.lrn')
+    if layout == "NHWC" and beta == 0.75:
+        return lrn2(x, local_size, alpha, beta, knorm, layout)
+    return lm.lrn(x, local_size, alpha, beta, knorm, layout)
+
+# ---- max pool custom_vjp: fwd reduce_window, bwd mask+dilated pads ----
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,2,3))
+def mp2(x, kernel, stride, layout="NCHW"):
+    return pool_mod.max_pool2d.__wrapped__(x, kernel, stride, layout) if hasattr(pool_mod.max_pool2d, "__wrapped__") else _mp_fwd_raw(x, kernel, stride, layout)
+
+def _mp_fwd_raw(x, kernel, stride, layout):
+    h, w = pool_mod._spatial(x, layout)
+    ph, pw = pool_mod._ceil_pad(h, kernel, stride), pool_mod._ceil_pad(w, kernel, stride)
+    dims, strides, pad = pool_mod._window(kernel, stride, ph, pw, layout)
+    return lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pad)
+
+def _mp_fwd(x, kernel, stride, layout):
+    y = _mp_fwd_raw(x, kernel, stride, layout)
+    return y, (x, y)
+
+def _mp_bwd(kernel, stride, layout, res, g):
+    x, y = res
+    assert layout == "NHWC"
+    n, h, w, c = x.shape
+    ph, pw = pool_mod._ceil_pad(h, kernel, stride), pool_mod._ceil_pad(w, kernel, stride)
+    oh, ow = y.shape[1], y.shape[2]
+    neg = jnp.asarray(-jnp.inf, x.dtype) if x.dtype != jnp.bfloat16 else jnp.asarray(float(np.finfo(np.float32).min), x.dtype)
+    xp = jnp.pad(x, ((0,0),(0,ph),(0,pw),(0,0)), constant_values=neg)
+    dx = None
+    for ki in range(kernel):
+        for kj in range(kernel):
+            sl = lax.slice(xp, (0, ki, kj, 0),
+                           (n, ki+(oh-1)*stride+1, kj+(ow-1)*stride+1, c),
+                           (1, stride, stride, 1))
+            contrib = jnp.where(sl == y, g, jnp.zeros((), g.dtype))
+            hi_h = (h + ph) - (ki + (oh-1)*stride + 1)
+            hi_w = (w + pw) - (kj + (ow-1)*stride + 1)
+            padded = lax.pad(contrib, jnp.zeros((), g.dtype),
+                             ((0,0,0), (ki, hi_h, stride-1), (kj, hi_w, stride-1), (0,0,0)))
+            dx = padded if dx is None else dx + padded
+    return (dx[:, :h, :w, :],)
+mp2.defvjp(_mp_fwd, _mp_bwd)
+
+def mp_dispatch(x, kernel, stride, layout="NCHW"):
+    if layout == "NHWC":
+        return mp2(x, kernel, stride, layout)
+    return _mp_fwd_raw(x, kernel, stride, layout)
+
+def timeit(mods):
+    import importlib; lm = importlib.import_module('singa_tpu.ops.lrn')
+    orig = (ops.lrn, L.ops.lrn, ops.max_pool2d, L.ops.max_pool2d)
+    if "lrn" in mods: ops.lrn = L.ops.lrn = lrn_dispatch
+    if "pool" in mods: ops.max_pool2d = L.ops.max_pool2d = mp_dispatch
+    try:
+        cfg = alexnet_cifar10_full(batchsize=BS)
+        cfg.precision = "bfloat16"
+        tr = Trainer(cfg, {"data": {"pixel": (3,32,32), "label": ()}}, log_fn=lambda s: None)
+        tr.train_net.remat_types = set()
+        params, opt_state = tr.init(seed=0)
+        rng = np.random.default_rng(0)
+        batch = {"data": {
+            "pixel": jax.device_put(rng.standard_normal((BS,3,32,32)).astype(np.float32)),
+            "label": jax.device_put(rng.integers(0,10,(BS,)).astype(np.int32))}}
+        key = jax.random.PRNGKey(0)
+        params, opt_state, _ = tr.train_steps(params, opt_state, batch, 0, key, ITERS)
+        hard_sync(params)
+        t0 = time.perf_counter()
+        params, opt_state, _ = tr.train_steps(params, opt_state, batch, ITERS, key, ITERS)
+        hard_sync(params)
+        return (time.perf_counter()-t0)/ITERS
+    finally:
+        ops.lrn, L.ops.lrn, ops.max_pool2d, L.ops.max_pool2d = orig
+
+# numeric check of pool bwd vs autodiff oracle
+def check():
+    k = jax.random.PRNGKey(1)
+    x = jax.random.normal(k, (3, 9, 9, 5), jnp.float32)
+    g = jax.random.normal(jax.random.PRNGKey(2), (3, 4, 4, 5), jnp.float32)
+    ref = jax.vjp(lambda z: _mp_fwd_raw(z, 3, 2, "NHWC"), x)[1](g)[0]
+    got = jax.vjp(lambda z: mp2(z, 3, 2, "NHWC"), x)[1](g)[0]
+    print("pool bwd max diff:", float(jnp.max(jnp.abs(ref-got))))
+    # lrn check
+    x2 = jax.random.normal(k, (4, 6, 6, 16), jnp.float32)
+    g2 = jax.random.normal(jax.random.PRNGKey(3), (4, 6, 6, 16), jnp.float32)
+    import importlib; lm = importlib.import_module('singa_tpu.ops.lrn')
+    r1 = jax.vjp(lambda z: lm.lrn(z, 5, 1e-4, 0.75, 1.0, "NHWC"), x2)[1](g2)[0]
+    r2 = jax.vjp(lambda z: lrn2(z, 5, 1e-4, 0.75, 1.0, "NHWC"), x2)[1](g2)[0]
+    print("lrn bwd max diff:", float(jnp.max(jnp.abs(r1-r2))))
+
+check()
+for name, mods in [("lrn2", ["lrn"]), ("pool2", ["pool"]), ("both", ["lrn","pool"])]:
+    s = timeit(mods)
+    print(json.dumps({"variant": name, "step_ms": round(s*1e3,3),
+                      "mfu": round(mfu(MODEL_TFLOPS, s) or 0, 4)}))

@@ -1,0 +1,82 @@
+import json, sys, time, functools
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+from singa_tpu.core.trainer import Trainer
+from singa_tpu.models.vision import alexnet_cifar10_full
+from singa_tpu.utils.flops import mfu
+from singa_tpu.utils.profiler import hard_sync
+import singa_tpu.ops as ops
+import singa_tpu.core.layers as L
+
+BS, ITERS = 2048, 20
+MODEL_TFLOPS = 3.1211e12
+
+def _band(c, local_size, dtype):
+    idx = jnp.arange(c)
+    return (jnp.abs(idx[:, None] - idx[None, :]) <= local_size // 2).astype(dtype)
+
+def make_lrn(window_mode):
+    def wsum(t, local_size):
+        if window_mode == "dot":
+            return jnp.dot(t, _band(t.shape[-1], local_size, t.dtype))
+        half = local_size // 2
+        c = t.shape[-1]
+        tp = jnp.pad(t, [(0,0)]*(t.ndim-1) + [(half, half)])
+        out = None
+        for d in range(local_size):
+            sl = lax.slice_in_dim(tp, d, d + c, axis=-1)
+            out = sl if out is None else out + sl
+        return out
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(1,2,3,4,5))
+    def lrn_c(x, local_size=5, alpha=1.0, beta=0.75, knorm=1.0, layout="NCHW"):
+        return _fwd(x, local_size, alpha, beta, knorm, layout)[0]
+    def _fwd(x, local_size, alpha, beta, knorm, layout):
+        sq = jnp.square(x)
+        n = wsum(sq, local_size) * jnp.asarray(alpha/local_size, x.dtype) + jnp.asarray(knorm, x.dtype)
+        r = lax.rsqrt(n)
+        p = r * jnp.sqrt(r)
+        return x * p, (x, n, p)
+    def _bwd(local_size, alpha, beta, knorm, layout, res, g):
+        x, n, p = res
+        t = g * x * p / n
+        s = wsum(t, local_size)
+        dx = g * p - jnp.asarray(2*beta*alpha/local_size, x.dtype) * x * s
+        return (dx,)
+    lrn_c.defvjp(_fwd, _bwd)
+    def dispatch(x, local_size=5, alpha=1.0, beta=0.75, knorm=1.0, layout="NCHW"):
+        import importlib; lm = importlib.import_module('singa_tpu.ops.lrn')
+        if layout == "NHWC" and beta == 0.75:
+            return lrn_c(x, local_size, alpha, beta, knorm, layout)
+        return lm.lrn(x, local_size, alpha, beta, knorm, layout)
+    return dispatch
+
+def timeit(lrn_fn):
+    orig = (ops.lrn, L.ops.lrn)
+    ops.lrn = L.ops.lrn = lrn_fn
+    try:
+        cfg = alexnet_cifar10_full(batchsize=BS)
+        cfg.precision = "bfloat16"
+        tr = Trainer(cfg, {"data": {"pixel": (3,32,32), "label": ()}}, log_fn=lambda s: None)
+        tr.train_net.remat_types = set()
+        params, opt_state = tr.init(seed=0)
+        rng = np.random.default_rng(0)
+        batch = {"data": {
+            "pixel": jax.device_put(rng.standard_normal((BS,3,32,32)).astype(np.float32)),
+            "label": jax.device_put(rng.integers(0,10,(BS,)).astype(np.int32))}}
+        key = jax.random.PRNGKey(0)
+        params, opt_state, _ = tr.train_steps(params, opt_state, batch, 0, key, ITERS)
+        hard_sync(params)
+        t0 = time.perf_counter()
+        params, opt_state, _ = tr.train_steps(params, opt_state, batch, ITERS, key, ITERS)
+        hard_sync(params)
+        return (time.perf_counter()-t0)/ITERS
+    finally:
+        ops.lrn, L.ops.lrn = orig
+
+for name in ["dot", "shift"]:
+    s = timeit(make_lrn(name))
+    print(json.dumps({"variant": f"lrn_{name}", "step_ms": round(s*1e3,3),
+                      "mfu": round(mfu(MODEL_TFLOPS, s) or 0, 4)}))

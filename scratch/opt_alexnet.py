@@ -1,0 +1,131 @@
+import json, sys, time, functools
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+
+from singa_tpu.core.trainer import Trainer
+from singa_tpu.models.vision import alexnet_cifar10_full
+from singa_tpu.utils.flops import mfu
+from singa_tpu.utils.profiler import hard_sync
+import singa_tpu.ops as ops
+import singa_tpu.ops.lrn as lrn_mod
+import singa_tpu.ops.pool as pool_mod
+import singa_tpu.ops.dropout as drop_mod
+import singa_tpu.core.layers as L
+import singa_tpu.core.net as netmod
+
+BS, ITERS = 2048, 20
+MODEL_TFLOPS = 3.1211e12
+
+# ---- candidate 1: bf16 LRN (no f32 norm), with optional custom_vjp ----
+def _band(c, local_size, dtype):
+    idx = jnp.arange(c)
+    return (jnp.abs(idx[:, None] - idx[None, :]) <= local_size // 2).astype(dtype)
+
+def lrn_bf16(x, local_size=5, alpha=1.0, beta=0.75, knorm=1.0, layout="NCHW"):
+    if layout != "NHWC":
+        return lrn_mod.lrn(x, local_size, alpha, beta, knorm, layout)
+    sq = jnp.square(x)
+    norm = jnp.dot(sq, _band(x.shape[-1], local_size, x.dtype))
+    norm = norm * jnp.asarray(alpha / local_size, x.dtype) + jnp.asarray(knorm, x.dtype)
+    r = lax.rsqrt(norm)
+    return x * (r * jnp.sqrt(r))
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,2,3,4,5))
+def lrn_cvjp(x, local_size=5, alpha=1.0, beta=0.75, knorm=1.0, layout="NCHW"):
+    return lrn_bf16(x, local_size, alpha, beta, knorm, layout)
+
+def _lrn_fwd(x, local_size, alpha, beta, knorm, layout):
+    sq = jnp.square(x)
+    norm = jnp.dot(sq, _band(x.shape[-1], local_size, x.dtype))
+    norm = norm * jnp.asarray(alpha/local_size, x.dtype) + jnp.asarray(knorm, x.dtype)
+    r = lax.rsqrt(norm)
+    p = r * jnp.sqrt(r)          # n^{-3/4}
+    return x * p, (x, norm, p)
+
+def _lrn_bwd(local_size, alpha, beta, knorm, layout, res, g):
+    x, norm, p = res
+    # dx = g*p - 2*beta*(alpha/L) * x * B^T(g * x * p / norm)
+    t = g * x * p / norm
+    bt = _band(x.shape[-1], local_size, x.dtype)
+    s = jnp.dot(t, bt)
+    dx = g * p - jnp.asarray(2*beta*alpha/local_size, x.dtype) * x * s
+    return (dx,)
+lrn_cvjp.defvjp(_lrn_fwd, _lrn_bwd)
+
+# ---- candidate 2: max pool via shifted strided slices ----
+def max_pool_slices(x, kernel, stride, layout="NCHW"):
+    h, w = pool_mod._spatial(x, layout)
+    ph, pw = pool_mod._ceil_pad(h, kernel, stride), pool_mod._ceil_pad(w, kernel, stride)
+    oh, ow = pool_mod.pooled_size(h, kernel, stride), pool_mod.pooled_size(w, kernel, stride)
+    neg = jnp.asarray(-jnp.inf, x.dtype)
+    if layout == "NHWC":
+        xp = jnp.pad(x, ((0,0),(0,ph),(0,pw),(0,0)), constant_values=neg)
+        out = None
+        for ki in range(kernel):
+            for kj in range(kernel):
+                sl = lax.slice(xp, (0, ki, kj, 0),
+                               (xp.shape[0], ki+(oh-1)*stride+1, kj+(ow-1)*stride+1, xp.shape[3]),
+                               (1, stride, stride, 1))
+                out = sl if out is None else jnp.maximum(out, sl)
+        return out
+    return pool_mod.max_pool2d(x, kernel, stride, layout)
+
+# ---- candidate 3: dropout via rbg hardware bits ----
+def dropout_rbg(x, rate, rng, train=True):
+    if not train or rate <= 0.0:
+        return x
+    pkeep = 1.0 - rate
+    kd = jax.random.key_data(rng).astype(jnp.uint32).reshape(-1)
+    key = jnp.concatenate([kd, kd])[:4]
+    bits, _ = lax.rng_bit_generator(key, x.shape, dtype=jnp.uint32), None
+    bits = bits[1] if isinstance(bits, tuple) else bits
+    thresh = np.uint32(int(pkeep * (2**32 - 1)))
+    mask = (bits < thresh).astype(x.dtype) / jnp.asarray(pkeep, x.dtype)
+    return x * mask
+
+def timeit(mods, no_remat=False):
+    # monkeypatch
+    orig = (ops.lrn, L.ops.lrn, ops.max_pool2d, L.ops.max_pool2d, ops.dropout, L.ops.dropout)
+    if "lrn_bf16" in mods: ops.lrn = L.ops.lrn = lrn_bf16
+    if "lrn_cvjp" in mods: ops.lrn = L.ops.lrn = lrn_cvjp
+    if "pool" in mods: ops.max_pool2d = L.ops.max_pool2d = max_pool_slices
+    if "drop" in mods: ops.dropout = L.ops.dropout = dropout_rbg
+    try:
+        cfg = alexnet_cifar10_full(batchsize=BS)
+        cfg.precision = "bfloat16"
+        tr = Trainer(cfg, {"data": {"pixel": (3,32,32), "label": ()}}, log_fn=lambda s: None)
+        if no_remat:
+            tr.train_net.remat_types = set()
+            if tr.test_net: tr.test_net.remat_types = set()
+        params, opt_state = tr.init(seed=0)
+        rng = np.random.default_rng(0)
+        batch = {"data": {
+            "pixel": jax.device_put(rng.standard_normal((BS,3,32,32)).astype(np.float32)),
+            "label": jax.device_put(rng.integers(0,10,(BS,)).astype(np.int32))}}
+        key = jax.random.PRNGKey(0)
+        params, opt_state, _ = tr.train_steps(params, opt_state, batch, 0, key, ITERS)
+        hard_sync(params)
+        t0 = time.perf_counter()
+        params, opt_state, _ = tr.train_steps(params, opt_state, batch, ITERS, key, ITERS)
+        hard_sync(params)
+        return (time.perf_counter()-t0)/ITERS
+    finally:
+        ops.lrn, L.ops.lrn, ops.max_pool2d, L.ops.max_pool2d, ops.dropout, L.ops.dropout = orig
+
+for name, mods, nr in [
+    ("baseline", [], False),
+    ("lrn_bf16_noremat", ["lrn_bf16"], True),
+    ("lrn_cvjp", ["lrn_cvjp"], True),
+    ("pool_slices", ["pool"], False),
+    ("drop_rbg", ["drop"], False),
+    ("all", ["lrn_cvjp","pool","drop"], True),
+    ("all_bf16lrn", ["lrn_bf16","pool","drop"], True),
+]:
+    try:
+        s = timeit(mods, nr)
+        print(json.dumps({"variant": name, "step_ms": round(s*1e3,3),
+                          "mfu": round(mfu(MODEL_TFLOPS, s) or 0, 4)}))
+    except Exception as e:
+        print(json.dumps({"variant": name, "error": repr(e)[:300]}))

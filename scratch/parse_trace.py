@@ -1,0 +1,25 @@
+import sys, glob, json
+from tensorboard_plugin_profile.convert import raw_to_tool_data as rd
+xp = glob.glob("/root/repo/scratch/trace/plugins/profile/*/*.xplane.pb")
+xp.sort()
+xp = xp[-1:]
+params = {"graph_viewer_options": {}}
+try:
+    data, _ = rd.xspace_to_tool_data(xp, "op_profile", params)
+    d = json.loads(data)
+    # walk tree: byProgram or byCategory
+    def walk(node, depth=0, out=None):
+        m = node.get("metrics", {})
+        name = node.get("name","")
+        t = m.get("time", 0)
+        if depth <= 3 and t:
+            out.append((t, depth, name, m.get("flops",0)))
+        for ch in node.get("children", []):
+            walk(ch, depth+1, out)
+    out = []
+    root = d.get("byCategory") or d.get("byProgram")
+    walk(root, 0, out)
+    for t, depth, name, fl in out[:80]:
+        print(f"{'  '*depth}{name}: time={t:.4f} flops={fl:.4f}")
+except Exception as e:
+    print("op_profile failed:", repr(e)[:500])

@@ -1,0 +1,25 @@
+import sys, glob, json, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+from singa_tpu.core.trainer import Trainer
+from singa_tpu.models.vision import alexnet_cifar10_full
+from singa_tpu.utils.profiler import hard_sync
+
+BS = 2048
+cfg = alexnet_cifar10_full(batchsize=BS)
+cfg.precision = "bfloat16"
+tr = Trainer(cfg, {"data": {"pixel": (3,32,32), "label": ()}}, log_fn=lambda s: None)
+params, opt_state = tr.init(seed=0)
+rng = np.random.default_rng(0)
+batch = {"data": {
+    "pixel": jax.device_put(rng.standard_normal((BS,3,32,32)).astype(np.float32)),
+    "label": jax.device_put(rng.integers(0,10,(BS,)).astype(np.int32))}}
+key = jax.random.PRNGKey(0)
+params, opt_state, _ = tr.train_steps(params, opt_state, batch, 0, key, 5)
+hard_sync(params)
+logdir = "/root/repo/scratch/trace"
+with jax.profiler.trace(logdir):
+    params, opt_state, _ = tr.train_steps(params, opt_state, batch, 5, key, 5)
+    hard_sync(params)
+print("trace done")

@@ -1,0 +1,33 @@
+import sys, time, json
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from jax import lax
+from singa_tpu.utils.profiler import hard_sync
+
+shape = (2048, 4096)
+key = jax.random.PRNGKey(0)
+
+@jax.jit
+def tf_mask(k):
+    return (jax.random.uniform(k, shape) < 0.5).astype(jnp.bfloat16)
+
+@jax.jit
+def rbg_mask(k):
+    kd = jax.random.key_data(k).astype(jnp.uint32).reshape(-1)
+    key4 = jnp.tile(kd, 2)[:4]
+    _, bits = lax.rng_bit_generator(key4, shape, dtype=jnp.uint32)
+    return (bits < np.uint32(2**31)).astype(jnp.bfloat16)
+
+@jax.jit
+def tf_bits_mask(k):
+    bits = jax.random.bits(k, shape, dtype=jnp.uint32)
+    return (bits < np.uint32(2**31)).astype(jnp.bfloat16)
+
+for name, fn in [("threefry_uniform", tf_mask), ("threefry_bits", tf_bits_mask), ("rbg", rbg_mask)]:
+    out = fn(key); hard_sync(out)
+    t0 = time.perf_counter()
+    for i in range(50):
+        out = fn(jax.random.fold_in(key, i))
+    hard_sync(out)
+    dt = (time.perf_counter()-t0)/50
+    print(json.dumps({"rng": name, "ms": round(dt*1e3, 4)}))

@@ -1,0 +1,22 @@
+import sys
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp
+from singa_tpu.ops.lrn_pallas import relu_lrn, _relu_lrn_2d
+from singa_tpu.ops.lrn import lrn
+
+k = jax.random.PRNGKey(0)
+x = jax.random.normal(k, (2, 8, 8, 64), jnp.float32)
+g = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 8, 64), jnp.float32)
+# oracle: relu then NCHW lrn
+def oracle(z, relu):
+    a = jnp.maximum(z, 0.0) if relu else z
+    return lrn(jnp.transpose(a, (0,3,1,2)), 5, 1e-4, 0.75, 1.0, "NCHW").transpose(0,2,3,1)
+for relu in (False, True):
+    f = lambda z: relu_lrn(z, 5, 1e-4, 0.75, 1.0, relu=relu)
+    o = lambda z: oracle(z, relu)
+    y1, y2 = f(x), o(x)
+    print("relu=", relu, "fwd", float(jnp.max(jnp.abs(y1-y2))))
+    d1 = jax.vjp(f, x)[1](g)[0]
+    d2 = jax.vjp(o, x)[1](g)[0]
+    print("relu=", relu, "bwd", float(jnp.max(jnp.abs(d1-d2))))
+print("backend:", jax.default_backend())

@@ -1,0 +1,86 @@
+"""Minimal xplane.pb reader: aggregate XLA op durations per plane/line."""
+import sys, glob, struct, collections
+
+def read_varint(b, i):
+    r = 0; s = 0
+    while True:
+        x = b[i]; i += 1
+        r |= (x & 0x7f) << s
+        if not x & 0x80: return r, i
+        s += 7
+
+def fields(buf):
+    i = 0
+    while i < len(buf):
+        tag, i = read_varint(buf, i)
+        fn, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, i = read_varint(buf, i)
+        elif wt == 1:
+            v = buf[i:i+8]; i += 8
+        elif wt == 2:
+            ln, i = read_varint(buf, i)
+            v = buf[i:i+ln]; i += ln
+        elif wt == 5:
+            v = buf[i:i+4]; i += 4
+        else:
+            raise ValueError(f"wiretype {wt}")
+        yield fn, wt, v
+
+def parse(path):
+    data = open(path, "rb").read()
+    planes = []
+    for fn, wt, v in fields(data):
+        if fn == 1: planes.append(v)
+    out = []
+    for p in planes:
+        name = ""; lines = []; emeta = {}
+        for fn, wt, v in fields(p):
+            if fn == 2: name = v.decode()
+            elif fn == 3: lines.append(v)
+            elif fn == 4:
+                k = None; md = None
+                for f2, w2, v2 in fields(v):
+                    if f2 == 1: k = v2
+                    elif f2 == 2: md = v2
+                if md is not None:
+                    mid = mname = None
+                    for f3, w3, v3 in fields(md):
+                        if f3 == 1: mid = v3
+                        elif f3 == 2: mname = v3.decode()
+                    emeta[mid if mid is not None else k] = mname or ""
+        out.append((name, lines, emeta))
+    return out
+
+def agg(path, plane_filter="TPU"):
+    res = {}
+    for name, lines, emeta in parse(path):
+        if plane_filter not in name: continue
+        for ln in lines:
+            lname = ""; events = []
+            for fn, wt, v in fields(ln):
+                if fn == 2: lname = v.decode()
+                elif fn == 11: lname = v.decode() or lname
+                elif fn == 4: events.append(v)
+            d = collections.Counter(); cnt = collections.Counter()
+            for ev in events:
+                mid = dur = 0
+                for fn, wt, v in fields(ev):
+                    if fn == 1: mid = v
+                    elif fn == 3: dur = v
+                opname = emeta.get(mid, str(mid))
+                d[opname] += dur; cnt[opname] += 1
+            res[(name, lname)] = (d, cnt)
+    return res
+
+if __name__ == "__main__":
+    path = sorted(glob.glob(sys.argv[1] if len(sys.argv)>1 else
+        "/root/repo/scratch/trace/plugins/profile/*/*.xplane.pb"))[-1]
+    res = agg(path)
+    for (pname, lname), (d, cnt) in res.items():
+        tot = sum(d.values())
+        if tot == 0: continue
+        print(f"=== {pname} / {lname}: total {tot/1e9:.3f} ms")
+        for op, ps in d.most_common(40):
+            print(f"  {ps/1e9:8.3f} ms  x{cnt[op]:<4} {op[:110]}")
+        print()

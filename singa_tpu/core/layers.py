@@ -310,6 +310,15 @@ class PoolingLayer(Layer):
 
 @register_layer("kLRN")
 class LRNLayer(Layer):
+    """`fuse_from`: set by NeuralNet when this LRN's source is a plain
+    ReLU — apply() then receives the *pre-relu* tensor and runs the
+    fused Pallas relu+lrn kernel (ops/lrn_pallas.py), never
+    materializing the relu output on the train path (any other
+    consumers of the relu still get it from the ReLU layer; XLA
+    dead-code-eliminates it when unused)."""
+
+    fuse_from: str = ""
+
     def setup(self, src_shapes):
         p = self.cfg.lrn_param
         self.local_size = p.local_size if p else 5
@@ -321,8 +330,9 @@ class LRNLayer(Layer):
         self.out_shape = tuple(src_shapes[0])
 
     def apply(self, params, srcs, ctx):
-        return ops.lrn(srcs[0], self.local_size, self.alpha, self.beta,
-                       self.knorm, layout="NHWC")
+        return ops.relu_lrn(srcs[0], self.local_size, self.alpha, self.beta,
+                            self.knorm, relu=bool(self.fuse_from),
+                            layout="NHWC")
 
 
 @register_layer("kInnerProduct")

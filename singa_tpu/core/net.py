@@ -63,6 +63,7 @@ class NeuralNet:
             l.name: create_layer(l) for l in self.cfgs}
         self._setup()
         self._build_param_index()
+        self._fuse_relu_lrn()
         self.remat_types: set = set()
 
     # -- construction ------------------------------------------------------
@@ -97,6 +98,25 @@ class NeuralNet:
 
     def _consumer_index(self, src: str, dst: str) -> int:
         return self.graph.dsts_of(src).index(dst)
+
+    def _fuse_relu_lrn(self) -> None:
+        """Mark conv→relu→lrn chains for the fused Pallas kernel: the
+        LRN layer reads the pre-relu tensor and applies ReLU in-kernel
+        (see LRNLayer.fuse_from).  The ReLU layer still produces its
+        output for any other consumer; XLA removes it when unused."""
+        from .layers import LRNLayer, ReLULayer, SliceLayer
+        for name in self.topo:
+            layer = self.layers[name]
+            if not isinstance(layer, LRNLayer):
+                continue
+            if len(layer.cfg.srclayers) != 1:
+                continue
+            src = self.layers[layer.cfg.srclayers[0]]
+            if (isinstance(src, ReLULayer) and src.slope == 0.0
+                    and len(src.cfg.srclayers) == 1
+                    and not isinstance(self.layers[src.cfg.srclayers[0]],
+                                       SliceLayer)):
+                layer.fuse_from = src.cfg.srclayers[0]
 
     def _build_param_index(self) -> None:
         self.param_specs: Dict[str, ParamSpec] = {}
@@ -162,8 +182,12 @@ class NeuralNet:
         total_loss = jnp.zeros((), jnp.float32)
         for idx, name in enumerate(self.topo):
             layer = self.layers[name]
-            srcs = [self._src_out(outputs, src, name)
-                    for src in layer.cfg.srclayers]
+            fuse_from = getattr(layer, "fuse_from", "")
+            if fuse_from:
+                srcs = [outputs[fuse_from]]
+            else:
+                srcs = [self._src_out(outputs, src, name)
+                        for src in layer.cfg.srclayers]
             ctx = Context(batch=ctx_batch, train=train, rng=rng,
                           layer_index=idx, mesh=mesh,
                           compute_dtype=compute_dtype)

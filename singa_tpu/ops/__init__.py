@@ -2,7 +2,7 @@ from .activations import (relu, sigmoid, tanh, stanh, softplus, bnll,
                           square, threshold, power, sqrtop)
 from .conv import conv2d, im2col, conv_out_size
 from .pool import max_pool2d, avg_pool2d, pooled_size
-from .lrn import lrn
+from .lrn import lrn, relu_lrn
 from .loss import softmax_cross_entropy, topk_precision, softmax_loss_metrics
 from .dropout import dropout
 from .linear import linear
