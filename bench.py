@@ -1,16 +1,37 @@
-"""Benchmark: MNIST LeNet (examples/mnist/conv.conf, identical to the
-reference's conv.conf) training throughput on the available accelerator.
+"""Benchmark driver.  Prints ONE JSON line on stdout.
 
-Prints ONE JSON line on stdout: {"metric", "value", "unit",
-"vs_baseline"}.  Secondary metrics (AlexNet/CIFAR-10 MFU — north-star
-gate 2 — and transformer-LM MFU) go to stderr so the driver contract
-stays a single stdout line.
+The stdout metric is the north-star gate 2 (BASELINE.md): CIFAR-10
+AlexNet MFU on the 5-conv `alexnet_cifar10_full` stack, measured on
+the available accelerator at the throughput-optimal batch size.
+`vs_baseline` is value / 0.50 — the fraction of the >=50%-MFU gate —
+because the reference publishes no numbers of its own (README.md:1-5;
+BASELINE.md records its harness only).
 
-The reference publishes no numbers (README.md:1-5); BASELINE.md records
-its harness only.  `vs_baseline` is computed against REFERENCE_IMG_SEC,
-an estimate of the reference's single-node CPU throughput for the same
-conv.conf workload (batch 64, im2col+BLAS LeNet at ~1k img/s — the
-scale its 2015-era CPU cluster sweep targeted).
+Secondary metrics go to stderr so the driver contract stays a single
+stdout line:
+  * mnist_lenet_train_throughput — img/s/chip for the reference's own
+    examples/mnist/conv.conf (batch enlarged to fill the chip), with
+    vs_baseline grounded against REFERENCE_CPU_IMG_SEC: the SAME
+    conv.conf workload measured through this framework's CPU backend
+    on this host (single process, matching the reference's
+    single-node CPU worker; measured 2026-07-30, best window
+    4.7 ms/step at batch 64 => ~13.6k img/s).  Re-measure with
+    `JAX_PLATFORMS=cpu python bench.py --cpu-baseline`.
+  * cifar10_quick_mfu — the 3-conv caffe 'quick' net (its 32-channel
+    convs cap the 128-lane MXU well below the gate regardless of
+    software quality).
+  * transformer_lm_mfu — the transformer LM stack.
+  * mnist time-to-99%: produced by tools/convergence_run.py (a full
+    training run, too slow for every bench invocation); if a committed
+    CONVERGENCE.json exists its numbers are folded into the stdout
+    line as aux keys.
+
+Timing: ALL steps of a measurement run as ONE compiled lax.scan
+program (trainer.train_steps) — device-only inner loop, one dispatch —
+and sync is a host fetch (hard_sync), NOT jax.block_until_ready, which
+can return early on the tunneled axon platform (observed impossible
+>100% MFU).  Each metric reports the best of several scan windows
+(run-to-run noise on the tunnel is ~±5%).
 """
 
 from __future__ import annotations
@@ -22,108 +43,142 @@ import time
 
 import numpy as np
 
-REFERENCE_IMG_SEC = 1000.0
-BATCH = 512
-ITERS = 50
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+# Measured on this host — see module docstring and --cpu-baseline.
+REFERENCE_CPU_IMG_SEC = 13600.0
+
+GATE_MFU = 0.50
 
 
-def _time_steps(trainer, params, opt_state, batch, key, iters):
-    # NOTE: sync via host fetch (hard_sync), NOT jax.block_until_ready —
-    # the tunneled axon platform can return from block_until_ready before
-    # execution finishes, which yields impossible (>100% MFU) timings.
-    # Per-dispatch tunnel overhead is ~1ms, comparable to a small-model
-    # step, so all `iters` steps run as ONE compiled lax.scan program
-    # (trainer.train_steps) — device-only inner loop, one dispatch.
+def _best_window(trainer, params, opt_state, batch, key, iters, reps):
     from singa_tpu.utils.profiler import hard_sync
-    # warmup = one full scan call: compiles the nsteps program and runs it
     params, opt_state, _ = trainer.train_steps(
         params, opt_state, batch, 0, key, iters)
     hard_sync(params)
-    t0 = time.perf_counter()
-    params, opt_state, _ = trainer.train_steps(
-        params, opt_state, batch, iters, key, iters)
-    hard_sync(params)
-    return (time.perf_counter() - t0) / iters
+    best = float("inf")
+    for r in range(reps):
+        t0 = time.perf_counter()
+        params, opt_state, _ = trainer.train_steps(
+            params, opt_state, batch, iters, key, iters)
+        hard_sync(params)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
 
 
-def bench_lenet():
+def _lenet_trainer(batch_size):
     import jax
 
     from singa_tpu.config import load_model_config
     from singa_tpu.core.trainer import Trainer
 
-    cfg = load_model_config(
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     "examples/mnist/conv.conf"))
+    cfg = load_model_config(os.path.join(REPO, "examples/mnist/conv.conf"))
     for layer in cfg.neuralnet.layer:
         if layer.data_param:
-            layer.data_param.batchsize = BATCH
-    shapes = {"data": {"pixel": (28, 28), "label": ()}}
-    trainer = Trainer(cfg, shapes, log_fn=lambda s: None)
+            layer.data_param.batchsize = batch_size
+    trainer = Trainer(cfg, {"data": {"pixel": (28, 28), "label": ()}},
+                      log_fn=lambda s: None)
     params, opt_state = trainer.init(seed=0)
-
     rng = np.random.default_rng(0)
     batch = {"data": {
         "pixel": jax.device_put(
-            rng.integers(0, 256, (BATCH, 28, 28)).astype(np.uint8)),
+            rng.integers(0, 256, (batch_size, 28, 28)).astype(np.uint8)),
         "label": jax.device_put(
-            rng.integers(0, 10, (BATCH,)).astype(np.int32)),
+            rng.integers(0, 10, (batch_size,)).astype(np.int32)),
     }}
-    step_s = _time_steps(trainer, params, opt_state, batch,
-                         jax.random.PRNGKey(0), ITERS)
-    img_sec = BATCH / step_s
-    print(json.dumps({
-        "metric": "mnist_lenet_train_throughput",
-        "value": round(img_sec, 1),
-        "unit": "img/sec/chip",
-        "vs_baseline": round(img_sec / REFERENCE_IMG_SEC, 2),
-    }))
+    return trainer, params, opt_state, batch
 
 
-def bench_alexnet_mfu(batch_size=2048, precision="bfloat16"):
-    """North-star gate 2: AlexNet/CIFAR-10 at >=50% MFU (BASELINE.md).
-
-    Measured on the actual 5-conv AlexNet stack adapted to 32x32
-    (models.vision.alexnet_cifar10_full); the 3-conv caffe quick net is
-    reported alongside as cifar10_quick (its 32-channel convs cap the
-    128-lane MXU well below the gate regardless of software quality).
-    """
+def _cifar_mfu(cfg, batch_size, iters, reps, precision):
+    """Shared CIFAR measurement: build trainer, synthetic batch, best
+    scan window, analytic train MFU."""
     import jax
 
     from singa_tpu.core.trainer import Trainer
-    from singa_tpu.models.vision import alexnet_cifar10, alexnet_cifar10_full
     from singa_tpu.utils.flops import mfu, net_train_flops
 
-    shapes = {"data": {"pixel": (3, 32, 32), "label": ()}}
+    cfg.precision = precision
+    trainer = Trainer(cfg, {"data": {"pixel": (3, 32, 32), "label": ()}},
+                      log_fn=lambda s: None)
+    params, opt_state = trainer.init(seed=0)
     rng = np.random.default_rng(0)
-    for metric, cfg, bs, iters in (
-            ("alexnet_cifar10_mfu", alexnet_cifar10_full(batchsize=batch_size),
-             batch_size, 20),
-            ("cifar10_quick_mfu", alexnet_cifar10(batchsize=batch_size),
-             batch_size, ITERS)):
-        cfg.precision = precision
-        trainer = Trainer(cfg, shapes, log_fn=lambda s: None)
-        params, opt_state = trainer.init(seed=0)
-        batch = {"data": {
-            "pixel": jax.device_put(
-                rng.standard_normal((bs, 3, 32, 32)).astype(np.float32)),
-            "label": jax.device_put(
-                rng.integers(0, 10, (bs,)).astype(np.int32)),
-        }}
-        step_s = _time_steps(trainer, params, opt_state, batch,
-                             jax.random.PRNGKey(0), iters)
-        flops = net_train_flops(trainer.train_net)
-        util = mfu(flops, step_s)
-        print(json.dumps({
-            "metric": metric, "value":
-                round(util, 4) if util is not None else None,
-            "unit": "fraction_of_peak", "img_sec": round(bs / step_s, 1),
-            "step_ms": round(step_s * 1e3, 3), "model_tflops_per_step":
-                round(flops / 1e12, 4), "precision": precision,
-        }), file=sys.stderr)
+    batch = {"data": {
+        "pixel": jax.device_put(
+            rng.standard_normal((batch_size, 3, 32, 32)).astype(np.float32)),
+        "label": jax.device_put(
+            rng.integers(0, 10, (batch_size,)).astype(np.int32)),
+    }}
+    step_s = _best_window(trainer, params, opt_state, batch,
+                          jax.random.PRNGKey(0), iters, reps)
+    flops = net_train_flops(trainer.train_net)
+    return mfu(flops, step_s), step_s, flops
 
 
-def bench_transformer_mfu(batch_size=8, seq_len=1024, precision="bfloat16"):
+def bench_alexnet_mfu(batch_size=8192, iters=10, reps=4,
+                      precision="bfloat16"):
+    """North-star gate 2 (the judged stdout metric)."""
+    from singa_tpu.models.vision import alexnet_cifar10_full
+
+    util, step_s, flops = _cifar_mfu(alexnet_cifar10_full(
+        batchsize=batch_size), batch_size, iters, reps, precision)
+    return {
+        "metric": "alexnet_cifar10_mfu",
+        "value": round(util, 4) if util is not None else None,
+        "unit": "fraction_of_peak",
+        "vs_baseline": (round(util / GATE_MFU, 4)
+                        if util is not None else None),
+        "img_sec": round(batch_size / step_s, 1),
+        "step_ms": round(step_s * 1e3, 3),
+        "batch": batch_size,
+        "model_tflops_per_step": round(flops / 1e12, 4),
+        "precision": precision,
+    }
+
+
+def bench_lenet(batch_size=512, iters=50, reps=3):
+    import jax
+
+    trainer, params, opt_state, batch = _lenet_trainer(batch_size)
+    step_s = _best_window(trainer, params, opt_state, batch,
+                          jax.random.PRNGKey(0), iters, reps)
+    img_sec = batch_size / step_s
+    return {
+        "metric": "mnist_lenet_train_throughput",
+        "value": round(img_sec, 1),
+        "unit": "img/sec/chip",
+        "vs_baseline": round(img_sec / REFERENCE_CPU_IMG_SEC, 2),
+        "baseline_img_sec_cpu": REFERENCE_CPU_IMG_SEC,
+    }
+
+
+def bench_cpu_baseline(iters=20, reps=5):
+    """Measure REFERENCE_CPU_IMG_SEC on this host: the reference's own
+    conv.conf (batch 64) through the CPU backend, single process."""
+    import jax
+
+    trainer, params, opt_state, batch = _lenet_trainer(64)
+    step_s = _best_window(trainer, params, opt_state, batch,
+                          jax.random.PRNGKey(0), iters, reps)
+    print(json.dumps({"metric": "lenet_cpu_baseline",
+                      "value": round(64 / step_s, 1),
+                      "unit": "img/sec", "step_ms":
+                          round(step_s * 1e3, 3)}))
+
+
+def bench_quick_mfu(batch_size=2048, iters=50, reps=3,
+                    precision="bfloat16"):
+    from singa_tpu.models.vision import alexnet_cifar10
+
+    util, step_s, _ = _cifar_mfu(alexnet_cifar10(batchsize=batch_size),
+                                 batch_size, iters, reps, precision)
+    return {"metric": "cifar10_quick_mfu",
+            "value": round(util, 4) if util is not None else None,
+            "unit": "fraction_of_peak",
+            "img_sec": round(batch_size / step_s, 1)}
+
+
+def bench_transformer_mfu(batch_size=8, seq_len=1024, iters=50,
+                          precision="bfloat16"):
     import jax
 
     from singa_tpu.core.trainer import Trainer
@@ -142,28 +197,47 @@ def bench_transformer_mfu(batch_size=8, seq_len=1024, precision="bfloat16"):
     batch = next(synthetic_token_batches(batch_size, seq_len, 32768))
     batch = jax.tree_util.tree_map(jax.device_put, batch)
     key = jax.random.PRNGKey(0)
-    step_s = _time_steps(trainer, params, opt_state, batch, key,
-                         ITERS)
+    step_s = _best_window(trainer, params, opt_state, batch, key, iters, 3)
     flops = compiled_flops(trainer.train_step, params, opt_state, batch,
                            0, key)
     util = mfu(flops, step_s) if flops else None
-    ntok = batch_size * seq_len
-    print(json.dumps({
-        "metric": "transformer_lm_mfu", "value":
-            round(util, 4) if util is not None else None,
-        "unit": "fraction_of_peak", "tok_sec": round(ntok / step_s, 1),
-        "step_ms": round(step_s * 1e3, 3), "precision": precision,
-    }), file=sys.stderr)
+    return {"metric": "transformer_lm_mfu",
+            "value": round(util, 4) if util is not None else None,
+            "unit": "fraction_of_peak",
+            "tok_sec": round(batch_size * seq_len / step_s, 1),
+            "step_ms": round(step_s * 1e3, 3)}
+
+
+def _convergence_aux():
+    path = os.path.join(REPO, "CONVERGENCE.json")
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            d = json.load(f)
+        out = {}
+        for k in ("mnist_test_accuracy", "time_to_99_seconds",
+                  "steps_to_99"):
+            if k in d:
+                out[k] = d[k]
+        return out
+    except Exception:
+        return {}
 
 
 def main() -> None:
-    bench_lenet()
+    if "--cpu-baseline" in sys.argv:
+        bench_cpu_baseline()
+        return
+    primary = bench_alexnet_mfu()
+    primary.update(_convergence_aux())
+    print(json.dumps(primary))
     if "--extra" in sys.argv:
-        for fn in (bench_alexnet_mfu, bench_transformer_mfu):
+        for fn in (bench_lenet, bench_quick_mfu, bench_transformer_mfu):
             try:
-                fn()
-            except Exception as e:  # secondary metrics must not break the
-                print(json.dumps({"metric": fn.__name__,  # driver contract
+                print(json.dumps(fn()), file=sys.stderr)
+            except Exception as e:  # secondary metrics must not break
+                print(json.dumps({"metric": fn.__name__,  # the contract
                                   "error": repr(e)}), file=sys.stderr)
 
 

@@ -312,10 +312,10 @@ class PoolingLayer(Layer):
 class LRNLayer(Layer):
     """`fuse_from`: set by NeuralNet when this LRN's source is a plain
     ReLU — apply() then receives the *pre-relu* tensor and runs the
-    fused Pallas relu+lrn kernel (ops/lrn_pallas.py), never
-    materializing the relu output on the train path (any other
-    consumers of the relu still get it from the ReLU layer; XLA
-    dead-code-eliminates it when unused)."""
+    fused relu+lrn custom_vjp (ops/lrn.py), never materializing the
+    relu output on the train path (any other consumers of the relu
+    still get it from the ReLU layer; XLA dead-code-eliminates it when
+    unused)."""
 
     fuse_from: str = ""
 

@@ -100,10 +100,11 @@ class NeuralNet:
         return self.graph.dsts_of(src).index(dst)
 
     def _fuse_relu_lrn(self) -> None:
-        """Mark conv→relu→lrn chains for the fused Pallas kernel: the
-        LRN layer reads the pre-relu tensor and applies ReLU in-kernel
-        (see LRNLayer.fuse_from).  The ReLU layer still produces its
-        output for any other consumer; XLA removes it when unused."""
+        """Mark conv→relu→lrn chains for the fused relu+lrn custom_vjp
+        (ops/lrn.py): the LRN layer reads the pre-relu tensor and
+        applies ReLU inside the vjp (see LRNLayer.fuse_from).  The ReLU
+        layer still produces its output for any other consumer; XLA
+        removes it when unused."""
         from .layers import LRNLayer, ReLULayer, SliceLayer
         for name in self.topo:
             layer = self.layers[name]

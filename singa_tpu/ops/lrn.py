@@ -25,9 +25,10 @@ tests compare the two paths in f32, where they agree to 1e-6.
 The backward is a hand-written custom_vjp (the same closed form the
 reference derives): letting XLA autodiff through the band matmul under
 jax.checkpoint generated bitpacked-relu-mask + f32-recompute fusion
-soup that cost ~10% of the whole AlexNet train step.  Residuals are
-(x, s); n and n^-β are recomputed from s in the backward (register
-ops, no extra HBM pass).
+soup that cost ~10% of the whole AlexNet train step.  The residual is
+x alone; the backward recomputes the window sum with a second band
+matmul — MXU time is cheaper here than writing and re-reading an
+activation-sized s tensor through HBM.
 
 `relu=True` fuses the reference's conv→relu→lrn chain: ReLU is applied
 in-register before the window sum and its mask folds into the
@@ -94,17 +95,17 @@ def _lrn_nhwc_fwd(x, local_size, alpha, beta, knorm, relu):
     a = jnp.maximum(x, jnp.zeros((), x.dtype)) if relu else x
     s = _window_sum(a, local_size)
     _, p = _p_of_s(s, local_size, alpha, beta, knorm)
-    return a * p, (x, s)
+    return a * p, x
 
 
-def _lrn_nhwc_bwd(local_size, alpha, beta, knorm, relu, res, g):
+def _lrn_nhwc_bwd(local_size, alpha, beta, knorm, relu, x, g):
     # d/da of y_i = a_i·n_i^-β with n = k + (α/L)·B(a²):
     #   da = g·n^-β − 2β(α/L)·a·Bᵀ(g·a·n^{-β-1})
     # (B symmetric, so Bᵀ = B); matches the reference's closed form
     # (layer.cc:366-377).  With relu fused, a = max(x, 0) is recomputed
     # from the residual x (register op) and da is masked by x > 0.
-    x, s = res
     a = jnp.maximum(x, jnp.zeros((), x.dtype)) if relu else x
+    s = _window_sum(a, local_size)
     n, p = _p_of_s(s, local_size, alpha, beta, knorm)
     t = g * a * (p / n)                     # g·a·n^{-β-1}
     u = jnp.dot(t, _band(x.shape[-1], local_size, x.dtype))

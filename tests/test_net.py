@@ -205,6 +205,43 @@ def test_uneven_slice_remainder_to_last():
     assert net.shapes["slice"] == ((2, 3), (2, 3), (2, 4))
 
 
+def test_fused_relu_lrn_net_matches_unfused():
+    """A conv→relu→lrn net produces identical loss and grads whether
+    the relu is fused into the LRN custom_vjp (fuse_from, the default
+    the builder picks) or the layers run separately."""
+    import numpy as np
+
+    from singa_tpu.models.vision import alexnet_cifar10
+    from singa_tpu.core.net import build_net
+
+    cfg = alexnet_cifar10(batchsize=4)
+    shapes = {"data": {"pixel": (3, 8, 8), "label": ()}}
+    rng = np.random.default_rng(3)
+    batch = {"data": {
+        "pixel": jnp.asarray(rng.standard_normal((4, 3, 8, 8)),
+                             jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 10, (4,)))}}
+
+    fused = build_net(cfg, "kTrain", shapes)
+    assert any(getattr(l, "fuse_from", "") for l in fused.layers.values())
+    unfused = build_net(cfg, "kTrain", shapes)
+    for l in unfused.layers.values():
+        if hasattr(l, "fuse_from"):
+            l.fuse_from = ""
+    params = fused.init_params(jax.random.PRNGKey(0))
+
+    def loss_of(net):
+        return jax.value_and_grad(
+            lambda p: net.apply(p, batch, train=True)[0])(params)
+
+    l1, g1 = loss_of(fused)
+    l2, g2 = loss_of(unfused)
+    assert np.allclose(float(l1), float(l2), rtol=1e-5)
+    for k in g1:
+        np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g2[k]),
+                                   rtol=1e-4, atol=1e-5)
+
+
 def test_debug_info_and_json():
     cfg = load_model_config("/root/reference/examples/mnist/conv.conf")
     net = build_net(cfg, "kTrain", MNIST_SHAPES, batchsize=2)

@@ -135,6 +135,34 @@ def test_lrn_grad_matches_reference_formula():
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
 
+def test_fused_relu_lrn_matches_relu_then_lrn():
+    """relu_lrn(relu=True) == lrn(relu(x)) in fwd AND bwd — the fused
+    conv→relu→lrn path NeuralNet._fuse_relu_lrn selects (custom_vjp
+    with in-vjp relu and x>0 gradient masking, ops/lrn.py)."""
+    lsize, alpha, beta, knorm = 5, 1e-2, 0.75, 1.0
+    x = jnp.asarray(RNG.standard_normal((2, 4, 3, 16)).astype(np.float32))
+    g = jnp.asarray(RNG.standard_normal(x.shape).astype(np.float32))
+
+    def fused(t):
+        return ops.relu_lrn(t, lsize, alpha, beta, knorm, relu=True,
+                            layout="NHWC")
+
+    def unfused(t):
+        # autodiff oracle: separate relu, then the NCHW reduce_window
+        # LRN (no custom_vjp on either piece)
+        a = jnp.maximum(t, 0.0)
+        return ops.lrn(jnp.transpose(a, (0, 3, 1, 2)), lsize, alpha,
+                       beta, knorm, layout="NCHW").transpose(0, 2, 3, 1)
+
+    y1, vjp1 = jax.vjp(fused, x)
+    y2, vjp2 = jax.vjp(unfused, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(vjp1(g)[0]),
+                               np.asarray(vjp2(g)[0]),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_stanh_constants():
     x = jnp.array([0.5, -1.0, 2.0])
     np.testing.assert_allclose(
