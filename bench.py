@@ -153,9 +153,15 @@ def bench_lenet(batch_size=512, iters=50, reps=3):
 
 def bench_cpu_baseline(iters=20, reps=5):
     """Measure REFERENCE_CPU_IMG_SEC on this host: the reference's own
-    conv.conf (batch 64) through the CPU backend, single process."""
+    conv.conf (batch 64) through the CPU backend, single process.
+    Run with JAX_PLATFORMS=cpu; refuses to record an accelerator
+    number as a CPU baseline."""
     import jax
 
+    if jax.default_backend() != "cpu":
+        raise SystemExit("--cpu-baseline must run on the CPU backend: "
+                         "JAX_PLATFORMS=cpu python bench.py --cpu-baseline "
+                         f"(got {jax.default_backend()!r})")
     trainer, params, opt_state, batch = _lenet_trainer(64)
     step_s = _best_window(trainer, params, opt_state, batch,
                           jax.random.PRNGKey(0), iters, reps)

@@ -21,21 +21,38 @@ def synthetic_image_batches(
         data_layer: str = "data",
         seed: int = 0,
         learnable: bool = True,
-        dtype=np.uint8) -> Iterator[Dict]:
+        dtype=np.uint8,
+        stream_seed: Optional[int] = None,
+        noise_std: float = 64.0) -> Iterator[Dict]:
     """Infinite iterator of {data_layer: {"pixel": u8, "label": i32}}.
 
     When `learnable`, each class k has a fixed random template and samples
     are noisy copies — so accuracy above chance proves learning end to end.
+
+    `seed` fixes the class templates.  `stream_seed` fixes the
+    label/noise stream independently; when omitted, the stream simply
+    continues the template RNG (the original behavior — note this is
+    NOT the same stream as an explicit stream_seed=seed, which
+    re-seeds from scratch).  A held-out test split is the SAME
+    templates with a different stream_seed (train/test
+    generalization, not memorization of identical batches).
+    `noise_std` sets the per-pixel gaussian corruption (higher =
+    harder task).  Pick stream_seed != seed so the stream does not
+    replay the bit sequence that generated the templates.
     """
     rng = np.random.default_rng(seed)
     templates = rng.integers(0, 256, (nclass,) + tuple(image_shape))
+    stream = (rng if stream_seed is None
+              else np.random.default_rng(stream_seed))
     while True:
-        labels = rng.integers(0, nclass, (batchsize,))
+        labels = stream.integers(0, nclass, (batchsize,))
         if learnable:
-            noise = rng.normal(0, 64, (batchsize,) + tuple(image_shape))
+            noise = stream.normal(0, noise_std,
+                                  (batchsize,) + tuple(image_shape))
             pixel = np.clip(templates[labels] + noise, 0, 255)
         else:
-            pixel = rng.integers(0, 256, (batchsize,) + tuple(image_shape))
+            pixel = stream.integers(0, 256,
+                                    (batchsize,) + tuple(image_shape))
         yield {data_layer: {
             "pixel": pixel.astype(dtype),
             "label": labels.astype(np.int32),

@@ -1,0 +1,27 @@
+"""North-star gate 1 as a test: conv.conf trains to >=99% held-out
+accuracy (marked slow — a real multi-hundred-step training run).
+
+Mirrors tools/convergence_run.py on the CPU test platform with a
+smaller test split so the suite stays tractable; the committed
+CONVERGENCE.json records the on-chip run.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_conv_conf_reaches_99_percent(tmp_path):
+    from singa_tpu.tools.convergence_run import run
+
+    final = run(os.path.join(REPO, "examples/mnist/conv.conf"),
+                target=0.99, max_steps=2000,
+                out=str(tmp_path / "conv.json"), noise_std=96.0,
+                chunk=100, test_batches=2, log=lambda s: None)
+    assert final["reached"], final
+    assert final["mnist_test_accuracy"] >= 0.99
