@@ -432,6 +432,10 @@ class ClusterConfig:
     pipeline_parallel: int = 0   # pp axis ("pipe")
     sequence_parallel: int = 0   # sp/cp axis ("seq")
     expert_parallel: int = 0     # ep axis ("expert")
+    # microbatches in flight per pipelined step (GPipe schedule); only
+    # meaningful with pipeline_parallel > 1 and layers carrying
+    # locationid stage marks.  0 → 2 * pipeline_parallel.
+    pipeline_microbatches: int = 0
 
 
 # ---------------------------------------------------------------------------

@@ -166,22 +166,32 @@ class NeuralNet:
     # -- forward -----------------------------------------------------------
     def apply(self, params: Dict[str, jnp.ndarray], batch: Dict[str, Any],
               rng: Optional[jax.Array] = None, train: Optional[bool] = None,
-              mesh=None, compute_dtype=None
+              mesh=None, compute_dtype=None,
+              layer_subset: Optional[List[str]] = None,
+              outputs: Optional[Dict[str, Any]] = None
               ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray], Dict[str, Any]]:
         """Run the net. Returns (total_loss, metrics, outputs).
 
         metrics aggregates every loss layer's dict (the reference's
         Performance blob, worker.cc:350-386); outputs maps layer name →
         activation (the reference's per-layer data_ blobs).
+
+        `layer_subset` (topo-ordered subsequence of self.topo) runs only
+        those layers, reading/extending the caller's `outputs` dict —
+        the pipeline runtime (parallel.pipeline_net) uses this to run
+        the pre/post groups through the SAME per-layer semantics
+        (fuse_from, remat, aux losses) as a plain forward.
         """
         if train is None:
             train = self.phase == "kTrain"
         full = self._resolve_params(params)
         ctx_batch = batch
-        outputs: Dict[str, Any] = {}
+        outputs = {} if outputs is None else outputs
         metrics: Dict[str, jnp.ndarray] = {}
         total_loss = jnp.zeros((), jnp.float32)
-        for idx, name in enumerate(self.topo):
+        names = self.topo if layer_subset is None else layer_subset
+        for name in names:
+            idx = self.topo.index(name)
             layer = self.layers[name]
             fuse_from = getattr(layer, "fuse_from", "")
             if fuse_from:

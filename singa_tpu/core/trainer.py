@@ -85,7 +85,12 @@ class Trainer:
     def __init__(self, model_cfg: ModelConfig,
                  input_shapes: Dict[str, Dict[str, tuple]],
                  log_fn: Callable[[str], None] = print,
-                 donate: bool = True, mesh=None):
+                 donate: bool = True, mesh=None, n_micro: int = 0):
+        """`mesh` + layers carrying locationid stage marks → the staged
+        region runs pipelined over the mesh's "pipe" axis (see
+        parallel.pipeline_net); `n_micro` sets the GPipe microbatch
+        count (default 2·pipe — ClusterProto.pipeline_microbatches maps
+        here from main.py)."""
         self.cfg = model_cfg
         self.log = log_fn
         self.mesh = mesh
@@ -96,9 +101,35 @@ class Trainer:
         self.val_net = self._maybe_net("kValidation", input_shapes)
         self.updater = make_updater(model_cfg.updater)
         self.multipliers = self.train_net.multipliers()
+        self._pipeline_nets = self._maybe_pipeline(n_micro)
         self._build_steps(donate)
         self.perf = Performance()
         self.timer = TimerInfo()
+
+    def _maybe_pipeline(self, n_micro: int) -> Dict[int, Any]:
+        """{id(net): PipelineNet} when the config marks stages AND the
+        mesh has a pipe axis > 1; {} otherwise (locationid marks are
+        inert on a flat mesh, matching the reference running a
+        location-annotated net on a single worker)."""
+        mesh = self.mesh
+        has_pipe = (mesh is not None and "pipe" in getattr(mesh, "shape", {})
+                    and mesh.shape["pipe"] > 1)
+        staged = any(l.locationid > 0
+                     for l in self.cfg.neuralnet.layer)
+        if not (has_pipe and staged):
+            return {}
+        from ..parallel.pipeline_net import PipelineNet
+        n_micro = n_micro or 2 * mesh.shape["pipe"]
+        nets = {}
+        for net in (self.train_net, self.test_net, self.val_net):
+            if net is not None:
+                nets[id(net)] = PipelineNet(net, n_micro)
+        return nets
+
+    def _net_apply(self, net):
+        """net.apply, or the pipelined equivalent when configured."""
+        pnet = self._pipeline_nets.get(id(net))
+        return net.apply if pnet is None else pnet.apply
 
     def _maybe_net(self, phase: str, input_shapes) -> Optional[NeuralNet]:
         try:
@@ -111,10 +142,11 @@ class Trainer:
     def _build_steps(self, donate: bool) -> None:
         net, updater, mults = self.train_net, self.updater, self.multipliers
         mesh, cdtype = self.mesh, self.compute_dtype
+        net_apply = self._net_apply(net)
 
         def train_step(params, opt_state, batch, step, rng):
             def loss_fn(p):
-                loss, metrics, _ = net.apply(p, batch, rng=rng, train=True,
+                loss, metrics, _ = net_apply(p, batch, rng=rng, train=True,
                                              mesh=mesh, compute_dtype=cdtype)
                 return loss, metrics
             (loss, metrics), grads = jax.value_and_grad(
@@ -147,7 +179,7 @@ class Trainer:
                 step_rng = jax.random.fold_in(rng, step)
 
                 def loss_fn(pp):
-                    loss, metrics, _ = net.apply(
+                    loss, metrics, _ = net_apply(
                         pp, batch, rng=step_rng, train=True, mesh=mesh,
                         compute_dtype=cdtype)
                     return loss, metrics
@@ -173,9 +205,11 @@ class Trainer:
                                    donate_argnums=donate_args)
 
         def make_eval(net):
+            apply_fn = self._net_apply(net)
+
             def eval_step(params, batch):
-                _, metrics, _ = net.apply(params, batch, train=False,
-                                          mesh=mesh, compute_dtype=cdtype)
+                _, metrics, _ = apply_fn(params, batch, train=False,
+                                         mesh=mesh, compute_dtype=cdtype)
                 return metrics
             return jax.jit(eval_step)
 

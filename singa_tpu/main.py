@@ -88,7 +88,9 @@ def main(argv=None) -> int:
         mesh = mesh_from_cluster(cluster, ptype)
         print(f"mesh: {dict(mesh.shape)} over {len(jax.devices())} devices")
 
-    trainer = Trainer(model, input_shapes, mesh=mesh)
+    trainer = Trainer(model, input_shapes, mesh=mesh,
+                      n_micro=(cluster.pipeline_microbatches
+                               if cluster else 0))
     params, opt_state = trainer.init(seed=args.seed)
     if mesh is not None:
         from .parallel import shard_opt_state, shard_params

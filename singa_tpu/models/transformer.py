@@ -37,10 +37,18 @@ def transformer_lm(vocab_size: int = 32000,
                    learning_rate: float = 3e-4,
                    precision: str = "float32",
                    tie_embeddings: bool = True,
-                   fused_head: bool = True) -> ModelConfig:
+                   fused_head: bool = True,
+                   pipeline_stages: int = 0) -> ModelConfig:
     """`fused_head` emits the kLMHeadLoss layer (chunked projection+xent,
     no (B,S,V) logits tensor) instead of kLMHead → kSoftmaxLoss; the two
-    forms are numerically identical."""
+    forms are numerically identical.
+
+    `pipeline_stages = S > 0` marks each block's layers with
+    LayerProto.locationid 1..S (num_layers must divide evenly) — the
+    reference's per-layer location field (model.proto:128) — which the
+    Trainer maps onto the mesh's "pipe" axis via
+    parallel.pipeline_net.PipelineNet.  Embedding and head keep
+    locationid 0 (pre/post groups)."""
     ffn_hidden = ffn_hidden or int(embed_dim * 8 / 3 // 64 * 64) or 256
     layers: List[Dict] = [
         {"name": "data", "type": "kSequenceData",
@@ -50,38 +58,46 @@ def transformer_lm(vocab_size: int = 32000,
         {"name": "embed", "type": "kEmbed", "srclayers": "data",
          "embed_param": {"vocab_size": vocab_size, "embed_dim": embed_dim}},
     ]
+    if pipeline_stages:
+        if num_layers % pipeline_stages:
+            raise ValueError(f"num_layers {num_layers} not divisible by "
+                             f"pipeline_stages {pipeline_stages}")
+        per_stage = num_layers // pipeline_stages
+
     src = "embed"
     for i in range(num_layers):
+        stage_mark = ({"locationid": i // per_stage + 1}
+                      if pipeline_stages else {})
         attn_in = f"ln{i}a"
         layers.append({"name": attn_in, "type": "kRMSNorm",
-                       "srclayers": src})
+                       "srclayers": src, **stage_mark})
         layers.append({
             "name": f"attn{i}", "type": "kAttention", "srclayers": attn_in,
             "attention_param": {
                 "num_heads": num_heads, "head_dim": head_dim,
                 "causal": True, "seq_parallel": seq_parallel,
-                "num_kv_heads": num_kv_heads}})
+                "num_kv_heads": num_kv_heads}, **stage_mark})
         layers.append({"name": f"res{i}a", "type": "kResidualAdd",
-                       "srclayers": [src, f"attn{i}"]})
+                       "srclayers": [src, f"attn{i}"], **stage_mark})
         ffn_in = f"ln{i}b"
         layers.append({"name": ffn_in, "type": "kRMSNorm",
-                       "srclayers": f"res{i}a"})
+                       "srclayers": f"res{i}a", **stage_mark})
         use_moe = moe_every > 0 and (i + 1) % moe_every == 0
         if use_moe:
             layers.append({
                 "name": f"moe{i}", "type": "kMoE", "srclayers": ffn_in,
                 "moe_param": {"num_experts": num_experts,
                               "experts_per_token": experts_per_token,
-                              "expert_hidden": ffn_hidden}})
+                              "expert_hidden": ffn_hidden}, **stage_mark})
             block_out = f"moe{i}"
         else:
             layers.append({
                 "name": f"ffn{i}", "type": "kFeedForward",
                 "srclayers": ffn_in,
-                "ffn_param": {"hidden_dim": ffn_hidden}})
+                "ffn_param": {"hidden_dim": ffn_hidden}, **stage_mark})
             block_out = f"ffn{i}"
         layers.append({"name": f"res{i}b", "type": "kResidualAdd",
-                       "srclayers": [f"res{i}a", block_out]})
+                       "srclayers": [f"res{i}a", block_out], **stage_mark})
         src = f"res{i}b"
 
     layers.append({"name": "ln_f", "type": "kRMSNorm", "srclayers": src})

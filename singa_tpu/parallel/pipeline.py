@@ -37,15 +37,21 @@ def stack_stage_params(per_stage_params: Sequence[Any]) -> Any:
 
 def pipeline_apply(mesh: Mesh, stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
                    stage_params: Any, x: jnp.ndarray,
-                   axis: str = "pipe") -> jnp.ndarray:
+                   axis: str = "pipe",
+                   batch_axis: str | None = None) -> jnp.ndarray:
     """Run microbatches through the pipeline.
 
     stage_params: pytree with leaves (n_stages, ...) — sharded over
     `axis` so each device keeps only its stage's slice.
-    x: (n_micro, micro_batch, ...) microbatched input (replicated).
-    Returns (n_micro, micro_batch, ...) outputs of the final stage.
+    x: (n_micro, micro_batch, ...) microbatched input.  With
+    `batch_axis` set (e.g. "data"), the micro_batch dim (dim 1) shards
+    over that axis so dp groups pipeline DIFFERENT slices of the batch
+    instead of replicating the work.
+    Returns (n_micro, micro_batch, ...) outputs of the final stage,
+    sharded the same way.
     """
     nstages = mesh.shape[axis]
+    x_spec = P(None, batch_axis) if batch_axis else P()
     if nstages == 1:
         params0 = jax.tree_util.tree_map(lambda p: p[0], stage_params)
         return jax.vmap(lambda mb: stage_fn(params0, mb))(x)
@@ -86,5 +92,5 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarra
         mask = (stage == nstages - 1).astype(outputs.dtype)
         return jax.lax.psum(outputs * mask, axis)
 
-    return shard_map(local, mesh=mesh, in_specs=(p_spec, P()),
-                     out_specs=P(), check_vma=False)(stage_params, x)
+    return shard_map(local, mesh=mesh, in_specs=(p_spec, x_spec),
+                     out_specs=x_spec, check_vma=False)(stage_params, x)

@@ -1,0 +1,226 @@
+"""Config-driven pipeline parallelism: LayerProto.locationid → stages.
+
+Reference: the Worker moves activations between layer `locationid`s via
+BridgeSrc/BridgeDst over ZMQ (model.proto:128,
+src/worker/worker.cc:139-155,240-302) — each location runs its slice of
+the net and activations hop point-to-point.  TPU-native successor: the
+net's layers partition into pipeline stages by locationid, stage
+parameters stack along a leading stage axis sharded over the mesh's
+"pipe" axis, and microbatched activations hop stage→stage through
+`pipeline_apply`'s ppermute schedule (parallel/pipeline.py).
+
+Stage assignment contract (validated, fail-loud):
+  * locationid == 0 layers topologically BEFORE the first staged layer
+    form the `pre` group (data/parsers/embedding — replicated compute,
+    like the reference running its input layers on every worker's
+    location 0);
+  * locationid 1..S mark the S pipeline stages.  SPMD requires the
+    stages be structurally identical (same layer types and param
+    shapes, in order) — true for transformer blocks, the model family
+    pipeline parallelism exists for.  Each stage must consume exactly
+    one cross-stage tensor and produce one.
+  * locationid == 0 layers topologically AFTER the staged region form
+    the `post` group (head + loss).
+
+The whole thing stays inside the Trainer's flat param dict: stacking
+happens inside the jitted loss (its transpose, unstacking, is the
+gradient path), so the updater, checkpointing, and cadence machinery
+are untouched.  `remat=True` wraps each stage in jax.checkpoint —
+GPipe with per-stage rematerialization, bounding activation memory at
+O(n_micro) boundary tensors instead of O(n_micro · per-stage
+activations).
+
+Layers inside stages must be rng-free (transformer blocks are); a
+stage layer that calls ctx.layer_rng() fails loudly at trace time.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.layers import Context
+from ..core.net import NeuralNet
+from .pipeline import pipeline_apply
+
+
+class PipelineError(ValueError):
+    pass
+
+
+def stage_assignment(net: NeuralNet) -> Tuple[List[str], List[List[str]],
+                                              List[str]]:
+    """(pre, stages, post) layer-name groups from locationid, in the
+    net's topological order."""
+    topo = net.topo
+    loc = {name: net.layers[name].cfg.locationid for name in topo}
+    staged = [n for n in topo if loc[n] > 0]
+    if not staged:
+        raise PipelineError("no layer has locationid > 0")
+    ids = sorted({loc[n] for n in staged})
+    if ids != list(range(1, len(ids) + 1)):
+        raise PipelineError(f"locationids must be contiguous 1..S, got {ids}")
+    first = topo.index(staged[0])
+    last = max(topo.index(n) for n in staged)
+    pre = [n for n in topo[:first] if loc[n] == 0]
+    mid0 = [n for n in topo[first:last + 1] if loc[n] == 0]
+    if mid0:
+        raise PipelineError(
+            f"layers {mid0} sit between pipeline stages but have "
+            f"locationid 0 — assign them to a stage")
+    post = [n for n in topo[last + 1:]]
+    stages = [[n for n in topo if loc[n] == s] for s in ids]
+    return pre, stages, post
+
+
+def _stage_param_names(net: NeuralNet, stage: List[str]) -> List[str]:
+    names = []
+    for lname in stage:
+        for spec in net.layers[lname].param_specs:
+            names.append(spec.name)
+    return names
+
+
+def _validate_uniform(net: NeuralNet, stages: List[List[str]]) -> None:
+    t0 = [net.layers[n].cfg.type for n in stages[0]]
+    s0 = [net.param_specs[p].shape for p in _stage_param_names(net,
+                                                              stages[0])]
+    for i, st in enumerate(stages[1:], 2):
+        ti = [net.layers[n].cfg.type for n in st]
+        si = [net.param_specs[p].shape
+              for p in _stage_param_names(net, st)]
+        if ti != t0 or si != s0:
+            raise PipelineError(
+                f"stage {i} is not structurally identical to stage 1: "
+                f"types {ti} vs {t0}, param shapes {si} vs {s0}")
+
+
+def _external_input(net: NeuralNet, stage: List[str]) -> str:
+    """The single srclayer reference crossing into this stage."""
+    inside = set(stage)
+    ext = []
+    for lname in stage:
+        for src in net.layers[lname].cfg.srclayers:
+            if src not in inside:
+                ext.append(src)
+    uniq = sorted(set(ext))
+    if len(uniq) != 1:
+        raise PipelineError(
+            f"stage {stage} must consume exactly one external tensor, "
+            f"found {uniq}")
+    return uniq[0]
+
+
+class PipelineNet:
+    """Pipelined evaluator over a built NeuralNet (see module doc)."""
+
+    def __init__(self, net: NeuralNet, n_micro: int):
+        self.net = net
+        self.n_micro = n_micro
+        self.pre, self.stages, self.post = stage_assignment(net)
+        _validate_uniform(net, self.stages)
+        self.stage_inputs = [_external_input(net, st)
+                             for st in self.stages]
+        # the schedule always forwards the topologically-LAST layer's
+        # output of each stage, so anything else consuming a different
+        # layer of the previous stage would silently get wrong numerics
+        for s in range(1, len(self.stages)):
+            if self.stage_inputs[s] != self.stages[s - 1][-1]:
+                raise PipelineError(
+                    f"stage {s + 1} must consume stage {s}'s last layer "
+                    f"{self.stages[s - 1][-1]!r}, not "
+                    f"{self.stage_inputs[s]!r}")
+        last = self.stages[-1][-1]
+        staged_names = {n for st in self.stages for n in st}
+        for name in self.post:
+            for src in net.layers[name].cfg.srclayers:
+                if src in staged_names and src != last:
+                    raise PipelineError(
+                        f"post layer {name!r} consumes mid-stage layer "
+                        f"{src!r}; only the final stage output "
+                        f"{last!r} crosses out of the pipeline")
+        self.param_names = [_stage_param_names(net, st)
+                            for st in self.stages]
+
+    @property
+    def n_stages(self) -> int:
+        return len(self.stages)
+
+    def _stack_params(self, params: Dict[str, jnp.ndarray]):
+        """{stage-0 param name: (S, ...) stacked leaf}."""
+        full = self.net._resolve_params(params)
+        out = {}
+        for j, name0 in enumerate(self.param_names[0]):
+            out[name0] = jnp.stack(
+                [full[names[j]] for names in self.param_names])
+        return out
+
+    def apply(self, params, batch, rng=None, train: Optional[bool] = None,
+              mesh=None, compute_dtype=None, axis: str = "pipe",
+              remat: bool = True):
+        """Pipelined forward (+ loss): pre group → microbatched staged
+        region over the pipe axis → post group.  Same signature shape
+        as NeuralNet.apply; returns (total_loss, metrics, outputs).
+        The pre/post groups run through NeuralNet.apply(layer_subset=…)
+        so their per-layer semantics (fuse_from, remat, aux losses)
+        stay identical to the unpipelined net."""
+        if mesh is None or axis not in mesh.shape:
+            raise PipelineError(f"PipelineNet.apply needs a mesh with a "
+                                f"{axis!r} axis")
+        if train is None:
+            train = self.net.phase == "kTrain"
+        outputs: Dict[str, Any] = {}
+        metrics: Dict[str, jnp.ndarray] = {}
+
+        total_loss, m, _ = self.net.apply(
+            params, batch, rng=rng, train=train, mesh=mesh,
+            compute_dtype=compute_dtype, layer_subset=self.pre,
+            outputs=outputs)
+        metrics.update(m)
+
+        x = outputs[self.stage_inputs[0]]
+        b = x.shape[0]
+        if b % self.n_micro:
+            raise PipelineError(f"batch {b} not divisible by n_micro "
+                                f"{self.n_micro}")
+        xm = x.reshape((self.n_micro, b // self.n_micro) + x.shape[1:])
+
+        template = self.stages[0]
+        tmpl_inp = self.stage_inputs[0]
+
+        def stage_fn(stage_params, mb):
+            louts = {tmpl_inp: mb}
+            out = None
+            for name in template:
+                layer = self.net.layers[name]
+                srcs = [louts[src] for src in layer.cfg.srclayers]
+                ctx = Context(batch=None, train=train, rng=None,
+                              layer_index=self.net.topo.index(name),
+                              mesh=None, compute_dtype=compute_dtype)
+                out = layer.apply(stage_params, srcs, ctx)
+                louts[name] = out
+            return out
+
+        if remat:
+            stage_fn = jax.checkpoint(stage_fn)
+
+        stacked = self._stack_params(params)
+        # shard microbatches over "data" so dp groups pipeline different
+        # batch slices; falls back to replicated work when the
+        # microbatch doesn't divide (correct either way — just wasteful)
+        dp = mesh.shape.get("data", 1)
+        batch_axis = ("data" if dp > 1
+                      and (b // self.n_micro) % dp == 0 else None)
+        y = pipeline_apply(mesh, stage_fn, stacked, xm, axis=axis,
+                           batch_axis=batch_axis)
+        last_out = self.stages[-1][-1]
+        outputs[last_out] = y.reshape((b,) + y.shape[2:])
+
+        post_loss, m, _ = self.net.apply(
+            params, batch, rng=rng, train=train, mesh=mesh,
+            compute_dtype=compute_dtype, layer_subset=self.post,
+            outputs=outputs)
+        metrics.update(m)
+        return total_loss + post_loss, metrics, outputs

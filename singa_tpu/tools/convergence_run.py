@@ -12,9 +12,10 @@ net starts at chance and has to learn.
 
 Writes CONVERGENCE.json at the repo root; bench.py folds its numbers
 into the judged stdout line.  Two wall-clocks are reported:
-`time_to_99_seconds` from process start (includes XLA compiles — what
-a user experiences) and `train_time_to_99_seconds` counting only
-train/eval execution after the first compiled step.
+`time_to_99_seconds` from the start of run() (includes XLA compiles —
+what a user experiences) and `train_time_to_99_seconds` counting every
+train chunk and eval at warm-execution speed (programs pre-compiled
+before timing starts).
 
 Usage: python -m singa_tpu.tools.convergence_run [--target 0.99]
        [--max-steps 10000] [--out CONVERGENCE.json] [--noise-std 96]
@@ -29,12 +30,11 @@ import time
 
 import numpy as np
 
-T0 = time.time()
-
 
 def run(conf: str, target: float, max_steps: int, out: str,
         noise_std: float, chunk: int, test_batches: int,
         log=print) -> dict:
+    t_start = time.time()
     import jax
 
     from ..config import load_model_config
@@ -66,31 +66,38 @@ def run(conf: str, target: float, max_steps: int, out: str,
     step = 0
     train_s = 0.0
     result = None
-    acc0 = test_accuracy(params)
+    acc0 = test_accuracy(params)   # also compiles test_step
     log(f"step-0 test accuracy {acc0:.4f} (chance ~0.10)")
+    # pre-compile the scan program so every timed chunk below is warm
+    # execution (train_time_to_99_seconds counts ALL train steps + all
+    # evals, excluding only XLA compilation)
+    warm = [next(train_iter) for _ in range(chunk)]
+    warm_stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *warm)
+    trainer.train_steps.lower(params, opt_state, warm_stacked, 0, rng,
+                              chunk, True).compile()
     while step < max_steps:
         n = min(chunk, max_steps - step)
-        batches = [next(train_iter) for _ in range(n)]
-        stacked = jax.tree_util.tree_map(
-            lambda *xs: np.stack(xs), *batches)
+        batches = ([next(train_iter) for _ in range(n)]
+                   if step or n != chunk else warm)
+        stacked = (jax.tree_util.tree_map(lambda *xs: np.stack(xs),
+                                          *batches)
+                   if step or n != chunk else warm_stacked)
         t0 = time.perf_counter()
         params, opt_state, _ = trainer.train_steps(
             params, opt_state, stacked, step, rng, n, True)
         jax.block_until_ready(
             jax.tree_util.tree_leaves(params)[0])
-        if step > 0:          # first chunk includes the XLA compile
-            train_s += time.perf_counter() - t0
+        train_s += time.perf_counter() - t0
         step += n
         t0 = time.perf_counter()
         acc = test_accuracy(params)
-        if step > n:
-            train_s += time.perf_counter() - t0
+        train_s += time.perf_counter() - t0
         log(f"step-{step} test accuracy {acc:.4f}")
         if acc >= target and result is None:
             result = {
                 "mnist_test_accuracy": round(acc, 4),
                 "steps_to_99": step,
-                "time_to_99_seconds": round(time.time() - T0, 2),
+                "time_to_99_seconds": round(time.time() - t_start, 2),
                 "train_time_to_99_seconds": round(train_s, 2),
             }
             break

@@ -1,0 +1,99 @@
+"""Config-wired pipeline parallelism (locationid → "pipe" axis).
+
+Reference: model.proto:128 locationid; worker.cc:139-155,240-302 moves
+activations between locations via bridge layers.  Here a config-built
+transformer with locationid stage marks must train identically to the
+same net unpipelined (VERDICT r1 item 3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from singa_tpu.core.trainer import Trainer
+from singa_tpu.models.transformer import (synthetic_token_batches,
+                                          transformer_lm)
+from singa_tpu.parallel.mesh import make_mesh
+from singa_tpu.parallel.pipeline_net import (PipelineError, PipelineNet,
+                                             stage_assignment)
+from singa_tpu.core.net import build_net
+
+CFG = dict(vocab_size=64, num_layers=4, embed_dim=32, num_heads=2,
+           head_dim=16, ffn_hidden=64, seq_len=32, batchsize=16,
+           train_steps=10)
+SHAPES = {"data": {"input": (32,), "target": (32,)}}
+
+
+def _batch():
+    return next(synthetic_token_batches(16, 32, 64, seed=5))
+
+
+def test_stage_assignment_from_locationid():
+    cfg = transformer_lm(pipeline_stages=4, **CFG)
+    net = build_net(cfg, "kTrain", SHAPES)
+    pre, stages, post = stage_assignment(net)
+    assert "embed" in pre and "data" in pre
+    assert len(stages) == 4
+    assert all(len(s) == 6 for s in stages)  # ln,attn,res,ln,ffn,res
+    assert post[-1] == "loss" and "ln_f" in post
+
+
+def test_pipeline_net_matches_unpipelined():
+    """One full train step (fwd+bwd+update) through the locationid
+    pipeline over pipe=4 equals the unpipelined net, params and loss."""
+    mesh = make_mesh(jax.devices(), data=2, pipe=4, model=1)
+    cfg_p = transformer_lm(pipeline_stages=4, **CFG)
+    cfg_r = transformer_lm(**CFG)
+    batch = _batch()
+
+    tr_p = Trainer(cfg_p, SHAPES, log_fn=lambda s: None, donate=False,
+                   mesh=mesh)
+    assert tr_p._pipeline_nets, "pipeline path not selected"
+    tr_r = Trainer(cfg_r, SHAPES, log_fn=lambda s: None, donate=False)
+
+    params, opt = tr_r.init(seed=0)
+    rng = jax.random.PRNGKey(2)
+    p1, o1, m1 = tr_p.train_step(dict(params), {k: dict(v) for k, v in
+                                                opt.items()}, batch, 0, rng)
+    p2, o2, m2 = tr_r.train_step(params, opt, batch, 0, rng)
+    assert np.allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+    for k in p2:
+        np.testing.assert_allclose(np.asarray(p1[k]), np.asarray(p2[k]),
+                                   rtol=2e-4, atol=2e-5, err_msg=k)
+
+
+def test_pipeline_eval_matches_and_flat_mesh_inert():
+    mesh = make_mesh(jax.devices(), data=2, pipe=4, model=1)
+    cfg_p = transformer_lm(pipeline_stages=4, **CFG)
+    batch = _batch()
+    tr_p = Trainer(cfg_p, SHAPES, log_fn=lambda s: None, donate=False,
+                   mesh=mesh)
+    # locationid marks are inert without a pipe axis (reference: a
+    # location-annotated net still runs on one worker)
+    tr_flat = Trainer(cfg_p, SHAPES, log_fn=lambda s: None, donate=False)
+    assert not tr_flat._pipeline_nets
+    params, _ = tr_flat.init(seed=1)
+    m1 = tr_p.test_step(params, batch)
+    m2 = tr_flat.test_step(params, batch)
+    assert np.allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+
+
+def test_pipeline_validation_fails_loud():
+    cfg = transformer_lm(pipeline_stages=2, **CFG)
+    # corrupt: give a mid-region layer locationid 0
+    for l in cfg.neuralnet.layer:
+        if l.name == "ffn1":
+            l.locationid = 0
+    net = build_net(cfg, "kTrain", SHAPES)
+    with pytest.raises(PipelineError, match="locationid 0"):
+        PipelineNet(net, 4)
+
+
+def test_pipeline_microbatch_divisibility():
+    cfg = transformer_lm(pipeline_stages=4, **CFG)
+    net = build_net(cfg, "kTrain", SHAPES)
+    with pytest.raises(PipelineError, match="divisible"):
+        mesh = make_mesh(jax.devices(), data=1, pipe=4, model=1, seq=2)
+        pn = PipelineNet(net, 3)   # 16 % 3 != 0
+        pn.apply(net.init_params(jax.random.PRNGKey(0)), _batch(),
+                 mesh=mesh)
