@@ -190,8 +190,9 @@ class NeuralNet:
         metrics: Dict[str, jnp.ndarray] = {}
         total_loss = jnp.zeros((), jnp.float32)
         names = self.topo if layer_subset is None else layer_subset
+        topo_index = {n: i for i, n in enumerate(self.topo)}
         for name in names:
-            idx = self.topo.index(name)
+            idx = topo_index[name]
             layer = self.layers[name]
             fuse_from = getattr(layer, "fuse_from", "")
             if fuse_from:

@@ -85,12 +85,21 @@ class Trainer:
     def __init__(self, model_cfg: ModelConfig,
                  input_shapes: Dict[str, Dict[str, tuple]],
                  log_fn: Callable[[str], None] = print,
-                 donate: bool = True, mesh=None, n_micro: int = 0):
+                 donate: bool = True, mesh=None, n_micro: int = 0,
+                 ngroups: int = 1):
         """`mesh` + layers carrying locationid stage marks → the staged
         region runs pipelined over the mesh's "pipe" axis (see
         parallel.pipeline_net); `n_micro` sets the GPipe microbatch
         count (default 2·pipe — ClusterProto.pipeline_microbatches maps
-        here from main.py)."""
+        here from main.py).
+
+        When UpdaterProto's consistency knobs request the async tier
+        (param_type Elastic with moving_rate > 0, or RandomSync —
+        parallel.elastic.async_active), `run` exchanges params with a
+        center copy at sync_frequency after warmup_steps, exactly the
+        reference worker's cadence (worker.cc:44-55); `ngroups` scales
+        Elastic's alpha = moving_rate/ngroups (param_manager.cc:15).
+        Multi-replica groups run through parallel.elastic.ReplicaSet."""
         self.cfg = model_cfg
         self.log = log_fn
         self.mesh = mesh
@@ -102,6 +111,9 @@ class Trainer:
         self.updater = make_updater(model_cfg.updater)
         self.multipliers = self.train_net.multipliers()
         self._pipeline_nets = self._maybe_pipeline(n_micro)
+        from ..parallel.elastic import ElasticController, async_active
+        self.elastic = (ElasticController(model_cfg.updater, ngroups)
+                        if async_active(model_cfg.updater) else None)
         self._build_steps(donate)
         self.perf = Performance()
         self.timer = TimerInfo()
@@ -264,6 +276,16 @@ class Trainer:
                 m = -(-after // freq) * freq
             return m
 
+        if self.elastic is not None:
+            # chunks may not run past a sync step: the center exchange
+            # happens on the host after that step completes
+            freq = self.cfg.updater.sync_frequency
+            warm = self.cfg.updater.warmup_steps
+            e = (warm if step < warm
+                 else warm + ((step - warm) // freq + 1) * freq)
+            if self.elastic.sync_now(step):
+                e = step
+            n = min(n, e - step + 1)
         for freq, after in ((self.cfg.test_frequency,
                              self.cfg.test_after_steps),
                             (self.cfg.validation_frequency,
@@ -323,6 +345,13 @@ class Trainer:
                     break
 
         rng = jax.random.PRNGKey(seed ^ 0x5eed)
+        if self.elastic is not None:
+            # center seeds lazily from the first post-warmup params
+            # inside maybe_sync (worker.cc:50-55 pushes AFTER warmup)
+            self.log(f"async consistency tier active: "
+                     f"{self.cfg.updater.param_type} sync_frequency="
+                     f"{self.cfg.updater.sync_frequency} warmup="
+                     f"{self.cfg.updater.warmup_steps}")
         history: List[Dict[str, float]] = []
         step = start_step
         while step < self.cfg.train_steps:
@@ -378,6 +407,11 @@ class Trainer:
                     self.log(f"step-{s}: {self.perf.to_string()}")
                     self.log(self.timer.to_string())
                     self.perf.reset()
+            if self.elastic is not None:
+                # chunks are cut so at most the LAST step is a sync step
+                params = self.elastic.maybe_sync(
+                    step + n - 1, params,
+                    rng=jax.random.fold_in(rng, step + n - 1))
             last = step + n - 1
             if (ckpt is not None and self.cfg.checkpoint_frequency > 0
                     and last >= self.cfg.checkpoint_after_steps

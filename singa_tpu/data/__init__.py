@@ -12,10 +12,17 @@ from .synthetic import synthetic_image_batches
 
 
 def resolve_data_source(model_cfg, batchsize: int, seed: int = 0,
-                        force_synthetic: bool = False
+                        force_synthetic: bool = False,
+                        stream_seed: int | None = None
                         ) -> Tuple[Iterator, Callable[[], Iterator]]:
     """Pick (train_iter, test_iter_factory) for a model config: shard
-    folders from DataProto.path when they exist locally, else synthetic."""
+    folders from DataProto.path when they exist locally, else synthetic.
+
+    `seed` fixes the synthetic task (class templates / LM transition
+    table); `stream_seed` varies only the sample stream — async replica
+    groups pass a different stream_seed per replica so they train
+    different data of the SAME task (a different `seed` would hand each
+    replica an unrelated task and make their center average garbage)."""
     train_path = test_path = None
     train_name = test_name = "data"
     layers = model_cfg.neuralnet.layer if model_cfg.neuralnet else []
@@ -25,10 +32,13 @@ def resolve_data_source(model_cfg, batchsize: int, seed: int = 0,
         if layer.type == "kSequenceData" and layer.seqdata_param:
             from ..models.transformer import synthetic_token_batches
             p = layer.seqdata_param
+            # the transition table is keyed by table_seed (fixed), so
+            # different seeds here already share one "language"
             mk = lambda s: synthetic_token_batches(  # noqa: E731
                 batchsize, p.seq_len, p.vocab_size, seed=s,
-                data_layer=layer.name)
-            return mk(seed), (lambda: mk(seed + 1))
+                data_layer=layer.name, table_seed=1234 + seed)
+            return (mk(stream_seed if stream_seed is not None
+                       else seed), (lambda: mk(seed + 7919)))
 
     for layer in layers:
         if layer.type in ("kShardData", "kLMDBData") and layer.data_param:
@@ -45,12 +55,18 @@ def resolve_data_source(model_cfg, batchsize: int, seed: int = 0,
         train_iter = prefetch(
             shard_batches(train_path, batchsize, train_name, seed=seed))
     else:
+        # train/test must share the class templates (`seed`) and differ
+        # only in the sample stream — templates keyed by different
+        # seeds are unrelated tasks and make test accuracy pure noise
         train_iter = synthetic_image_batches(
-            batchsize, data_layer=train_name, seed=seed)
+            batchsize, data_layer=train_name, seed=seed,
+            stream_seed=(stream_seed if stream_seed is not None
+                         else seed + 101))
     if shard_ok(test_path):
         test_factory = lambda: shard_batches(
             test_path, batchsize, test_name, loop=False)
     else:
         test_factory = lambda: synthetic_image_batches(
-            batchsize, data_layer=test_name, seed=seed + 1)
+            batchsize, data_layer=test_name, seed=seed,
+            stream_seed=seed + 202)
     return train_iter, test_factory

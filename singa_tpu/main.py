@@ -88,9 +88,18 @@ def main(argv=None) -> int:
         mesh = mesh_from_cluster(cluster, ptype)
         print(f"mesh: {dict(mesh.shape)} over {len(jax.devices())} devices")
 
+    # worker-group topology (cluster.h:49-60): nworkers/nprocs_per_group
+    # data-parallel groups; with the async consistency tier active each
+    # group is a replica against the shared center (ReplicaSet below)
+    ngroups = 1
+    if cluster is not None and not cluster.synchronous:
+        ngroups = max(cluster.nworkers
+                      // max(cluster.nprocs_per_group, 1), 1)
+
     trainer = Trainer(model, input_shapes, mesh=mesh,
                       n_micro=(cluster.pipeline_microbatches
-                               if cluster else 0))
+                               if cluster else 0),
+                      ngroups=ngroups)
     params, opt_state = trainer.init(seed=args.seed)
     if mesh is not None:
         from .parallel import shard_opt_state, shard_params
@@ -152,6 +161,40 @@ def main(argv=None) -> int:
         if test_factory is not None:
             inner_factory = test_factory
             test_factory = lambda: _sharded(inner_factory())  # noqa: E731
+
+    from .parallel.elastic import async_active
+    if ngroups > 1 and async_active(model.updater):
+        # multi-group async tier: each group trains its own replica and
+        # exchanges with the shared center at the UpdaterProto cadence
+        from .data import resolve_data_source as _rds
+        from .parallel.elastic import ReplicaSet
+        for flag, what in ((args.resume, "--resume"),
+                           (workspace, "checkpointing (workspace)"),
+                           (mesh is not None, "mesh sharding")):
+            if flag:
+                print(f"warning: {what} is not supported on the "
+                      f"multi-group async simulation path; ignoring",
+                      file=sys.stderr)
+        print(f"async replica groups: {ngroups} x "
+              f"{model.updater.param_type}")
+        rs = ReplicaSet(trainer, ngroups, seed=args.seed)
+        # same task (seed), a distinct sample stream per replica
+        iters = [_rds(model, bs, seed=args.seed,
+                      stream_seed=args.seed + 1000 * (g + 1),
+                      force_synthetic=args.synthetic)[0]
+                 for g in range(ngroups)]
+        center, history = rs.run(iters, model.train_steps,
+                                 seed=args.seed)
+        last = {k: v for k, v in history[0][-1].items()}
+        print(f"training done (center of {ngroups} replicas): " +
+              ", ".join(f"{k} : {v:.6f}" for k, v in sorted(last.items())))
+        if trainer.test_step is not None and test_factory is not None \
+                and center is not None and model.test_steps > 0:
+            avg = trainer.evaluate(center, test_factory(),
+                                   model.test_steps, trainer.test_step)
+            print("center test: " + ", ".join(
+                f"{k} : {v:.6f}" for k, v in sorted(avg.items())))
+        return 0
 
     params, opt_state, history = trainer.run(
         params, opt_state, train_iter, test_iter_factory=test_factory,

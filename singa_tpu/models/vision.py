@@ -269,8 +269,13 @@ def mlp_mnist(batchsize: int = 1000, train_steps: int = 60000,
         "name": "deep-big-simple-mlp",
         "train_steps": train_steps,
         "display_frequency": 30,
+        # the reference's mlp.conf runs the Elastic-averaging consistency
+        # tier (mlp.conf:12-16): sync with the center every 8 steps
+        # after 60 warmup steps — live through Trainer.run/ReplicaSet
         "updater": {"type": "kSGD", "base_learning_rate": 0.001,
                     "learning_rate_change_method": "kStep", "gamma": 0.997,
-                    "learning_rate_change_frequency": 60},
+                    "learning_rate_change_frequency": 60,
+                    "param_type": "Elastic", "sync_frequency": 8,
+                    "moving_rate": 0.9, "warmup_steps": 60},
         "neuralnet": {"layer": layers},
     })

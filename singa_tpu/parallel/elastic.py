@@ -84,6 +84,17 @@ def sync_sample_ratio(bandwidth_mb_s: float, nservers: int, nworkers: int,
     return float(max(0.0, min(1.0, throughput / demand)))
 
 
+def async_active(ucfg: UpdaterConfig | None) -> bool:
+    """True when UpdaterProto's consistency knobs request the async
+    tier: RandomSync explicitly, or Elastic with a nonzero moving_rate
+    (the reference's mlp.conf sets moving_rate 0.9, sync_frequency 8;
+    moving_rate's default 0 keeps plain-sync configs inert)."""
+    return (ucfg is not None and ucfg.sync_frequency > 0
+            and (ucfg.param_type == "RandomSync"
+                 or (ucfg.param_type == "Elastic"
+                     and ucfg.moving_rate > 0)))
+
+
 class ElasticController:
     """Cross-slice consistency driver with the reference's cadence knobs.
 
@@ -113,9 +124,22 @@ class ElasticController:
                 % self.cfg.sync_frequency == 0)
 
     def maybe_sync(self, step: int, params, rng=None):
-        if self.center is None or not self.sync_now(step):
+        """Exchange with the center at the cadence.  The center
+        initializes lazily from the FIRST post-warmup params — the
+        reference worker pushes its trained params to the servers after
+        the warmup loop, before any sync (worker.cc:50-55); seeding the
+        center from step-0 initialization would make the first exchange
+        snap the replica most of the way back toward init."""
+        if not self.sync_now(step):
+            return params
+        if self.center is None:
+            self.init(params)
             return params
         if self.mode == "RandomSync":
+            if self.snapshot is None:
+                # replica joining an existing center (multi-group):
+                # its first delta baseline is its own current params
+                self.snapshot = jax.tree_util.tree_map(jnp.copy, params)
             rng = rng if rng is not None else jax.random.PRNGKey(step)
             params, self.center, self.snapshot = randomsync_update(
                 params, self.center, self.snapshot, self.sample_ratio, rng)
@@ -123,3 +147,77 @@ class ElasticController:
             params, self.center = elastic_update(params, self.center,
                                                  self.alpha)
         return params
+
+
+class ReplicaSet:
+    """The reference's worker-group topology as a runtime: `ngroups`
+    replicas train asynchronously against one shared center copy (the
+    parameter server's role, param.cc:102-256).
+
+    Replicas step round-robin on one controller process — the
+    single-host simulation of groups that the reference runs as
+    separate processes; each holds its own params/opt_state and data
+    stream and exchanges with the shared center at the UpdaterProto
+    cadence (sync_frequency after warmup_steps, worker.cc:44-55).
+    The center is ONE shared copy (the PS role); RandomSync snapshots
+    are PER-replica state (param.cc:102-213 keeps them per worker —
+    sharing them would erase other replicas' contributions from the
+    center).  The center seeds lazily from the first replica to finish
+    warmup (worker.cc:50-55).  Cross-host deployment runs one
+    ReplicaSet member per slice with transport via jax.distributed.
+    """
+
+    def __init__(self, trainer, ngroups: int, seed: int = 0):
+        self.trainer = trainer
+        self.ngroups = ngroups
+        cfg = trainer.cfg.updater
+        self.controllers = [ElasticController(cfg, ngroups)
+                            for _ in range(ngroups)]
+        self.replicas = []
+        for g in range(ngroups):
+            # every replica starts from the SAME initialization — the
+            # reference's group 0 initializes params and the other
+            # groups fetch them from the servers (worker.cc Setup), so
+            # replicas share a loss basin and their center average is
+            # meaningful.  Divergence comes from the data streams.
+            p, o = trainer.init(seed=seed)
+            self.replicas.append({"params": p, "opt": o})
+
+    def _share_center(self, src: ElasticController) -> None:
+        for c in self.controllers:
+            c.center = src.center   # snapshots stay per-replica
+
+    def run(self, data_iters, steps: int, seed: int = 0,
+            hooks=None):
+        """Train every replica for `steps` steps, round-robin (one step
+        per replica per round — simulated asynchrony: replicas hit the
+        center at interleaved times).  Returns the final center params
+        and per-replica metric history."""
+        if len(data_iters) != self.ngroups:
+            raise ValueError(f"need {self.ngroups} data iterators, got "
+                             f"{len(data_iters)}")
+        rng = jax.random.PRNGKey(seed ^ 0xA57)
+        history = [[] for _ in range(self.ngroups)]
+        for step in range(steps):
+            for g, rep in enumerate(self.replicas):
+                batch = next(data_iters[g])
+                step_rng = jax.random.fold_in(
+                    jax.random.fold_in(rng, step), g)
+                rep["params"], rep["opt"], metrics = \
+                    self.trainer.train_step(rep["params"], rep["opt"],
+                                            batch, step, step_rng)
+                ctl = self.controllers[g]
+                rep["params"] = ctl.maybe_sync(step, rep["params"],
+                                               rng=step_rng)
+                if ctl.center is not None:
+                    self._share_center(ctl)
+                history[g].append(
+                    {k: float(v) for k, v in metrics.items()})
+                if hooks:
+                    for h in hooks:
+                        h(step, g, history[g][-1])
+        return self.controllers[0].center, history
+
+    @property
+    def center(self):
+        return self.controllers[0].center

@@ -169,6 +169,13 @@ class PipelineNet:
         if mesh is None or axis not in mesh.shape:
             raise PipelineError(f"PipelineNet.apply needs a mesh with a "
                                 f"{axis!r} axis")
+        if mesh.shape[axis] != self.n_stages:
+            # the schedule holds exactly one stage per pipe row; a
+            # mismatch would silently drop stages (local() applies only
+            # its first slice)
+            raise PipelineError(
+                f"{self.n_stages} locationid stages need pipe axis of "
+                f"the same size, mesh has {axis}={mesh.shape[axis]}")
         if train is None:
             train = self.net.phase == "kTrain"
         outputs: Dict[str, Any] = {}
