@@ -58,6 +58,8 @@ class Context:
     layer_index: int = 0
     mesh: Any = None            # jax.sharding.Mesh for SP/EP-aware layers
     compute_dtype: Any = None   # e.g. jnp.bfloat16 under ModelProto.precision
+    step: Any = None            # traced global step (cadence-aware layers,
+    #                             e.g. MnistProto.elastic_freq)
 
     def layer_rng(self) -> jax.Array:
         if self.rng is None:
@@ -155,7 +157,11 @@ class MnistImageLayer(Layer):
     The elastic-distortion surface the reference declares but left
     commented out (MnistProto kernel/sigma/alpha/beta/gamma,
     model.proto:211-225) is implemented on-device (ops/augment.py) and
-    applied in the training phase when any strength is nonzero."""
+    applied in the training phase when any strength is nonzero.
+    `elastic_freq` gates it to every freq-th step (the field the
+    reference reads in Setup, layer.cc:462, for exactly that cadence);
+    `resize` rescales samples to (resize, resize) (layer.cc:466-467
+    reshapes the output blob to that size)."""
 
     def setup(self, src_shapes):
         p = self.cfg.mnist_param
@@ -166,14 +172,33 @@ class MnistImageLayer(Layer):
             beta=p.beta, gamma=p.gamma) if p else {}
         self.distort_on = bool(p and (
             (p.alpha > 0 and p.kernel > 0) or p.beta > 0 or p.gamma > 0))
-        pix = src_shapes[0]["pixel"]
-        self.out_shape = tuple(pix)
+        self.elastic_freq = p.elastic_freq if p else 0
+        self.resize = p.resize if p else 0
+        pix = tuple(src_shapes[0]["pixel"])
+        if self.resize:
+            pix = pix[:1] + (self.resize, self.resize) + pix[3:]
+        self.out_shape = pix
 
     def apply(self, params, srcs, ctx):
         x = srcs[0]["pixel"].astype(jnp.float32)
+        if self.resize and x.shape[1:3] != (self.resize, self.resize):
+            x = jax.image.resize(
+                x, (x.shape[0], self.resize, self.resize) + x.shape[3:],
+                method="bilinear")
         if self.distort_on and ctx.train:
             from ..ops.augment import elastic_deform
-            x = elastic_deform(x, ctx.layer_rng(), **self.distort)
+            rng = ctx.layer_rng()
+            if self.elastic_freq > 1 and ctx.step is not None:
+                # distort only every elastic_freq-th step (layer.cc:462);
+                # lax.cond skips the displacement-field work entirely on
+                # off steps (jnp.where would compute-and-discard it)
+                on = (jnp.asarray(ctx.step) % self.elastic_freq) == 0
+                x = jax.lax.cond(
+                    on,
+                    lambda t: elastic_deform(t, rng, **self.distort),
+                    lambda t: t, x)
+            else:
+                x = elastic_deform(x, rng, **self.distort)
         x = x / self.norm_a - self.norm_b
         if ctx.compute_dtype is not None:
             x = x.astype(ctx.compute_dtype)
@@ -195,14 +220,43 @@ class RGBImageLayer(Layer):
         self.scale = p.scale if p else 1.0
         self.cropsize = p.cropsize if p else 0
         self.mirror = bool(p.mirror) if p else False
+        self.mean = (self._load_mean(p.meanfile)
+                     if p and p.meanfile else None)
         b, c, h, w = src_shapes[0]["pixel"]  # (B, C, H, W) host layout
         if self.cropsize:
             h = w = self.cropsize
         self.out_shape = (b, h, w, c)
 
+    @staticmethod
+    def _load_mean(path: str):
+        """Per-pixel mean record (the mean.binaryproto role,
+        layer.cc:579-583: ReadProtoFromBinaryFile + mean subtract).
+        Written by tools/loader.py compute_mean; fails loudly when the
+        configured file is missing or malformed."""
+        import numpy as _np
+
+        from ..data.records import Record
+        try:
+            with open(path, "rb") as f:
+                rec = Record.decode(f.read())
+            arr = _np.asarray(rec.image.data, _np.float32).reshape(
+                tuple(rec.image.shape))
+        except FileNotFoundError:
+            raise LayerError(
+                f"rgbimage_param.meanfile {path!r} does not exist — "
+                f"build it with singa_tpu.tools.loader compute_mean")
+        except Exception as e:
+            raise LayerError(
+                f"rgbimage_param.meanfile {path!r} is not a mean "
+                f"record: {e}")
+        return arr
+
     def apply(self, params, srcs, ctx):
         x = srcs[0]["pixel"].astype(jnp.float32)
+        # batch-supplied mean (pipeline) wins over the configured file
         mean = srcs[0].get("mean")
+        if mean is None and self.mean is not None:
+            mean = jnp.asarray(self.mean)
         if mean is not None:
             x = x - mean
         x = x.transpose(0, 2, 3, 1)  # → NHWC

@@ -168,7 +168,7 @@ class NeuralNet:
               rng: Optional[jax.Array] = None, train: Optional[bool] = None,
               mesh=None, compute_dtype=None,
               layer_subset: Optional[List[str]] = None,
-              outputs: Optional[Dict[str, Any]] = None
+              outputs: Optional[Dict[str, Any]] = None, step=None
               ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray], Dict[str, Any]]:
         """Run the net. Returns (total_loss, metrics, outputs).
 
@@ -202,7 +202,7 @@ class NeuralNet:
                         for src in layer.cfg.srclayers]
             ctx = Context(batch=ctx_batch, train=train, rng=rng,
                           layer_index=idx, mesh=mesh,
-                          compute_dtype=compute_dtype)
+                          compute_dtype=compute_dtype, step=step)
             if layer.cfg.type in self.remat_types:
                 out = jax.checkpoint(
                     lambda *s, _l=layer, _c=ctx: _l.apply(full, list(s), _c)
@@ -239,16 +239,22 @@ class NeuralNet:
         return self.graph.to_json()
 
     def debug_info(self, params: Dict[str, jnp.ndarray],
-                   outputs: Dict[str, Any]) -> str:
+                   outputs: Dict[str, Any],
+                   grads: Optional[Dict[str, jnp.ndarray]] = None) -> str:
         """Per-layer mean-absolute data norms — the reference's DebugInfo
-        printout (neuralnet.cc:350-378) used when ModelProto.debug."""
+        printout (neuralnet.cc:350-378) used when ModelProto.debug.
+        The reference prints data AND gradient L1 norms; pass `grads`
+        (the param-gradient pytree from the step) to include them."""
         lines = []
         for name in self.topo:
             out = outputs.get(name)
             if isinstance(out, jnp.ndarray) and out.dtype != jnp.int32:
                 lines.append(f"{name}: data {jnp.mean(jnp.abs(out)):.6f}")
         for pname, p in sorted(params.items()):
-            lines.append(f"{pname}: param {jnp.mean(jnp.abs(p)):.6f}")
+            line = f"{pname}: param {jnp.mean(jnp.abs(p)):.6f}"
+            if grads is not None and pname in grads:
+                line += f" grad {jnp.mean(jnp.abs(grads[pname])):.6f}"
+            lines.append(line)
         return "\n".join(lines)
 
 

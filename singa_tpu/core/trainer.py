@@ -159,7 +159,8 @@ class Trainer:
         def train_step(params, opt_state, batch, step, rng):
             def loss_fn(p):
                 loss, metrics, _ = net_apply(p, batch, rng=rng, train=True,
-                                             mesh=mesh, compute_dtype=cdtype)
+                                             mesh=mesh, compute_dtype=cdtype,
+                                             step=step)
                 return loss, metrics
             (loss, metrics), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
@@ -193,7 +194,7 @@ class Trainer:
                 def loss_fn(pp):
                     loss, metrics, _ = net_apply(
                         pp, batch, rng=step_rng, train=True, mesh=mesh,
-                        compute_dtype=cdtype)
+                        compute_dtype=cdtype, step=step)
                     return loss, metrics
                 (_, metrics), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(p)
@@ -227,6 +228,20 @@ class Trainer:
 
         self.test_step = make_eval(self.test_net) if self.test_net else None
         self.val_step = make_eval(self.val_net) if self.val_net else None
+
+        def debug_step(params, batch, step, rng):
+            """Per-layer activations + param grads for DebugInfo
+            (neuralnet.cc:350-378 prints data AND grad norms)."""
+            def loss_fn(p):
+                loss, _, outputs = net_apply(
+                    p, batch, rng=rng, train=True, mesh=mesh,
+                    compute_dtype=cdtype, step=step)
+                return loss, outputs
+            (_, outputs), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            return outputs, grads
+
+        self.debug_step = jax.jit(debug_step) if self.cfg.debug else None
 
     # -- init --------------------------------------------------------------
     def init(self, seed: int = 0):
@@ -407,6 +422,17 @@ class Trainer:
                     self.log(f"step-{s}: {self.perf.to_string()}")
                     self.log(self.timer.to_string())
                     self.perf.reset()
+            if (self.debug_step is not None
+                    and any(self.display_now(step + i) for i in range(n))):
+                # debug norms reflect the post-chunk params, so label
+                # them with the chunk's last step, not a mid-chunk one
+                s_dbg = step + n - 1
+                dbg_batch = batch if n == 1 else batches[-1]
+                outs, grads = self.debug_step(
+                    params, dbg_batch, s_dbg,
+                    jax.random.fold_in(rng, s_dbg))
+                self.log(f"step-{s_dbg} debug:\n" +
+                         self.train_net.debug_info(params, outs, grads))
             if self.elastic is not None:
                 # chunks are cut so at most the LAST step is a sync step
                 params = self.elastic.maybe_sync(

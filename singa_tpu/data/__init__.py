@@ -40,10 +40,30 @@ def resolve_data_source(model_cfg, batchsize: int, seed: int = 0,
             return (mk(stream_seed if stream_seed is not None
                        else seed), (lambda: mk(seed + 7919)))
 
+    train_skip = 0
     for layer in layers:
         if layer.type in ("kShardData", "kLMDBData") and layer.data_param:
+            if layer.type == "kLMDBData" and not force_synthetic:
+                p = layer.data_param.path
+                if p and (os.path.isfile(p)
+                          or os.path.isfile(os.path.join(p, "data.mdb"))):
+                    # refuse rather than silently substitute another
+                    # source for real LMDB data (layer.cc:237-328 walks
+                    # a caffe LMDB cursor; no LMDB reader is available
+                    # in this environment — convert with
+                    # tools/loader.py into a shard folder instead)
+                    raise NotImplementedError(
+                        f"kLMDBData layer {layer.name!r} points at an "
+                        f"existing LMDB environment {p!r}, which this "
+                        f"build cannot read; convert it to a shard "
+                        f"folder with singa_tpu.tools.loader")
+                import sys as _sys
+                print(f"warning: kLMDBData layer {layer.name!r} path "
+                      f"{p!r} not found; using the synthetic source",
+                      file=_sys.stderr)
             if "kTrain" not in layer.exclude:
                 train_path, train_name = layer.data_param.path, layer.name
+                train_skip = layer.data_param.random_skip
             else:
                 test_path, test_name = layer.data_param.path, layer.name
 
@@ -52,8 +72,21 @@ def resolve_data_source(model_cfg, batchsize: int, seed: int = 0,
                 os.path.isfile(os.path.join(p, "shard.dat")))
 
     if shard_ok(train_path):
+        # stream decorrelation on real shards rides DataProto.random_skip
+        # (layer.cc:646-673): each stream_seed draws a different initial
+        # skip.  File order is otherwise fixed — warn when a caller asks
+        # for distinct streams but the config gives no skip budget.
+        if stream_seed is not None and not train_skip:
+            import sys as _sys
+            print("warning: distinct data streams requested "
+                  "(stream_seed) but DataProto.random_skip is 0 — "
+                  "shard replicas will read identical record order",
+                  file=_sys.stderr)
         train_iter = prefetch(
-            shard_batches(train_path, batchsize, train_name, seed=seed))
+            shard_batches(train_path, batchsize, train_name,
+                          seed=(stream_seed if stream_seed is not None
+                                else seed),
+                          random_skip=train_skip))
     else:
         # train/test must share the class templates (`seed`) and differ
         # only in the sample stream — templates keyed by different

@@ -100,32 +100,15 @@ def main(argv=None) -> int:
                       n_micro=(cluster.pipeline_microbatches
                                if cluster else 0),
                       ngroups=ngroups)
-    params, opt_state = trainer.init(seed=args.seed)
-    if mesh is not None:
-        from .parallel import shard_opt_state, shard_params
-        params = shard_params(mesh, trainer.train_net, params)
-        opt_state = shard_opt_state(mesh, trainer.train_net, opt_state)
+
+    from .parallel.elastic import async_active
+    async_multi = ngroups > 1 and async_active(model.updater)
 
     workspace = args.workspace or (cluster.workspace if cluster else None)
     # an explicit --workspace is a request to checkpoint: default to a
     # final snapshot when the config doesn't set a cadence
     if args.workspace and model.checkpoint_frequency == 0:
         model.checkpoint_frequency = max(model.train_steps, 1)
-    start_step = 0
-    if args.resume:
-        if not workspace:
-            print("warning: --resume given but no workspace configured "
-                  "(set --workspace or ClusterProto.workspace); "
-                  "starting from scratch", file=sys.stderr)
-        else:
-            params, opt_state, start_step = trainer.resume(
-                params, opt_state, workspace)
-            if start_step > 0:
-                print(f"resumed from step {start_step}")
-            else:
-                print(f"no checkpoint found in {workspace}; "
-                      "starting from scratch")
-
     train_layer = next(
         (l for l in model.neuralnet.layer
          if l.type in ("kShardData", "kLMDBData", "kSequenceData")
@@ -142,6 +125,68 @@ def main(argv=None) -> int:
     # Data source: shard files if the configured path exists locally,
     # else the synthetic source (reference configs point at dead hosts).
     from .data import resolve_data_source
+
+    if async_multi:
+        # multi-group async tier: each group trains its own replica and
+        # exchanges with the shared center at the UpdaterProto cadence.
+        # Branches BEFORE single-group state (init/sharding/prefetch)
+        # is built — none of it is used on this path.
+        from .parallel.elastic import ReplicaSet
+        for flag, what in ((args.resume, "--resume"),
+                           (workspace, "checkpointing (workspace)"),
+                           (mesh is not None, "mesh sharding")):
+            if flag:
+                print(f"warning: {what} is not supported on the "
+                      f"multi-group async simulation path; ignoring",
+                      file=sys.stderr)
+        print(f"async replica groups: {ngroups} x "
+              f"{model.updater.param_type}")
+        rs = ReplicaSet(trainer, ngroups, seed=args.seed)
+        # same task (seed), a distinct sample stream per replica
+        iters = [resolve_data_source(
+                     model, bs, seed=args.seed,
+                     stream_seed=args.seed + 1000 * (g + 1),
+                     force_synthetic=args.synthetic)[0]
+                 for g in range(ngroups)]
+        center, history = rs.run(iters, model.train_steps,
+                                 seed=args.seed)
+        last = history[0][-1] if history and history[0] else {}
+        print(f"training done (center of {ngroups} replicas)" +
+              (": " + ", ".join(f"{k} : {v:.6f}"
+                                for k, v in sorted(last.items()))
+               if last else ""))
+        test_factory = resolve_data_source(
+            model, bs, seed=args.seed,
+            force_synthetic=args.synthetic)[1]
+        if trainer.test_step is not None and test_factory is not None \
+                and center is not None and model.test_steps > 0:
+            avg = trainer.evaluate(center, test_factory(),
+                                   model.test_steps, trainer.test_step)
+            print("center test: " + ", ".join(
+                f"{k} : {v:.6f}" for k, v in sorted(avg.items())))
+        return 0
+
+    params, opt_state = trainer.init(seed=args.seed)
+    if mesh is not None:
+        from .parallel import shard_opt_state, shard_params
+        params = shard_params(mesh, trainer.train_net, params)
+        opt_state = shard_opt_state(mesh, trainer.train_net, opt_state)
+
+    start_step = 0
+    if args.resume:
+        if not workspace:
+            print("warning: --resume given but no workspace configured "
+                  "(set --workspace or ClusterProto.workspace); "
+                  "starting from scratch", file=sys.stderr)
+        else:
+            params, opt_state, start_step = trainer.resume(
+                params, opt_state, workspace)
+            if start_step > 0:
+                print(f"resumed from step {start_step}")
+            else:
+                print(f"no checkpoint found in {workspace}; "
+                      "starting from scratch")
+
     train_iter, test_factory = resolve_data_source(
         model, bs, seed=args.seed, force_synthetic=args.synthetic)
 
@@ -161,40 +206,6 @@ def main(argv=None) -> int:
         if test_factory is not None:
             inner_factory = test_factory
             test_factory = lambda: _sharded(inner_factory())  # noqa: E731
-
-    from .parallel.elastic import async_active
-    if ngroups > 1 and async_active(model.updater):
-        # multi-group async tier: each group trains its own replica and
-        # exchanges with the shared center at the UpdaterProto cadence
-        from .data import resolve_data_source as _rds
-        from .parallel.elastic import ReplicaSet
-        for flag, what in ((args.resume, "--resume"),
-                           (workspace, "checkpointing (workspace)"),
-                           (mesh is not None, "mesh sharding")):
-            if flag:
-                print(f"warning: {what} is not supported on the "
-                      f"multi-group async simulation path; ignoring",
-                      file=sys.stderr)
-        print(f"async replica groups: {ngroups} x "
-              f"{model.updater.param_type}")
-        rs = ReplicaSet(trainer, ngroups, seed=args.seed)
-        # same task (seed), a distinct sample stream per replica
-        iters = [_rds(model, bs, seed=args.seed,
-                      stream_seed=args.seed + 1000 * (g + 1),
-                      force_synthetic=args.synthetic)[0]
-                 for g in range(ngroups)]
-        center, history = rs.run(iters, model.train_steps,
-                                 seed=args.seed)
-        last = {k: v for k, v in history[0][-1].items()}
-        print(f"training done (center of {ngroups} replicas): " +
-              ", ".join(f"{k} : {v:.6f}" for k, v in sorted(last.items())))
-        if trainer.test_step is not None and test_factory is not None \
-                and center is not None and model.test_steps > 0:
-            avg = trainer.evaluate(center, test_factory(),
-                                   model.test_steps, trainer.test_step)
-            print("center test: " + ", ".join(
-                f"{k} : {v:.6f}" for k, v in sorted(avg.items())))
-        return 0
 
     params, opt_state, history = trainer.run(
         params, opt_state, train_iter, test_iter_factory=test_factory,

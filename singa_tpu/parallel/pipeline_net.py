@@ -159,7 +159,7 @@ class PipelineNet:
 
     def apply(self, params, batch, rng=None, train: Optional[bool] = None,
               mesh=None, compute_dtype=None, axis: str = "pipe",
-              remat: bool = True):
+              remat: bool = True, step=None):
         """Pipelined forward (+ loss): pre group → microbatched staged
         region over the pipe axis → post group.  Same signature shape
         as NeuralNet.apply; returns (total_loss, metrics, outputs).
@@ -184,7 +184,7 @@ class PipelineNet:
         total_loss, m, _ = self.net.apply(
             params, batch, rng=rng, train=train, mesh=mesh,
             compute_dtype=compute_dtype, layer_subset=self.pre,
-            outputs=outputs)
+            outputs=outputs, step=step)
         metrics.update(m)
 
         x = outputs[self.stage_inputs[0]]
@@ -228,6 +228,6 @@ class PipelineNet:
         post_loss, m, _ = self.net.apply(
             params, batch, rng=rng, train=train, mesh=mesh,
             compute_dtype=compute_dtype, layer_subset=self.post,
-            outputs=outputs)
+            outputs=outputs, step=step)
         metrics.update(m)
         return total_loss + post_loss, metrics, outputs
