@@ -56,8 +56,38 @@ def _tokenize(text: str) -> List[Tuple[str, str, int]]:
 
 def _unquote(s: str) -> str:
     body = s[1:-1]
-    return re.sub(r"\\(.)", lambda m: {"n": "\n", "t": "\t"}.get(
-        m.group(1), m.group(1)), body)
+
+    def sub(m):
+        e = m.group(1)
+        if e.startswith("x"):
+            return chr(int(e[1:], 16))
+        return {"n": "\n", "t": "\t", "r": "\r"}.get(e, e)
+
+    return re.sub(r"\\(x[0-9a-fA-F]{2}|.)", sub, body)
+
+
+def _escape(v: str) -> str:
+    """Protobuf text-format string escaping: backslash, quote, the
+    common control characters, and \\xNN for other non-printables —
+    so dump() output always re-tokenizes (the tokenizer's string
+    pattern cannot cross a raw newline)."""
+    out = []
+    for ch in v:
+        if ch == "\\":
+            out.append("\\\\")
+        elif ch == '"':
+            out.append('\\"')
+        elif ch == "\n":
+            out.append("\\n")
+        elif ch == "\t":
+            out.append("\\t")
+        elif ch == "\r":
+            out.append("\\r")
+        elif ord(ch) < 0x20:
+            out.append(f"\\x{ord(ch):02x}")
+        else:
+            out.append(ch)
+    return "".join(out)
 
 
 def _coerce_scalar(kind: str, value: str) -> Any:
@@ -158,8 +188,7 @@ def dump(msg: Dict[str, Any], indent: int = 0) -> str:
             elif isinstance(v, bool):
                 out.append(f"{pad}{name}: {'true' if v else 'false'}")
             elif isinstance(v, str) and not re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*", v):
-                escaped = v.replace("\\", "\\\\").replace('"', '\\"')
-                out.append(f'{pad}{name}: "{escaped}"')
+                out.append(f'{pad}{name}: "{_escape(v)}"')
             elif isinstance(v, str):
                 # enum symbol — unquoted only if it looks like one that the
                 # schema declares; plain strings (e.g. layer type "kReLU")

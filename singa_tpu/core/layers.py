@@ -586,8 +586,11 @@ class BridgeDstLayer(BridgeSrcLayer):
 def create_layer(cfg: LayerConfig) -> Layer:
     if cfg.type not in LAYER_REGISTRY:
         # the sequence family registers on import and is kept lazy
-        # (it pulls in Pallas); load it on first unknown type
+        # (it pulls in Pallas); load it on first unknown type.  kRBM
+        # registers the same way from its model family.
         from . import seq_layers  # noqa: F401
+        from ..models.rbm import register_rbm_layer
+        register_rbm_layer()
     if cfg.type not in LAYER_REGISTRY:
         raise LayerError(f"unknown layer type {cfg.type!r} "
                          f"(registered: {sorted(LAYER_REGISTRY)})")

@@ -341,24 +341,11 @@ class Trainer:
         snapshot at the current step and a clean early return, so a
         preempted TPU job resumes from where it stopped instead of its
         last cadence checkpoint."""
-        ckpt = None
-        if workspace and self.cfg.checkpoint_frequency > 0:
-            from ..utils.checkpoint import CheckpointManager
-            ckpt = CheckpointManager(workspace)
-        interrupted = []
-        old_handlers = {}
-        if ckpt is not None:
-            import signal
-
-            def _on_signal(signum, frame):
-                interrupted.append(signum)
-
-            for sig in (signal.SIGTERM, signal.SIGINT):
-                try:
-                    old_handlers[sig] = signal.signal(sig, _on_signal)
-                except ValueError:   # non-main thread: no signal hooks
-                    break
-
+        if self.cfg.alg == "kContrastiveDivergence":
+            return self.run_cd(params, opt_state, train_iter,
+                               start_step=start_step, seed=seed,
+                               workspace=workspace)
+        ckpt, interrupted, old_handlers = self._ckpt_guard(workspace)
         rng = jax.random.PRNGKey(seed ^ 0x5eed)
         if self.elastic is not None:
             # center seeds lazily from the first post-warmup params
@@ -444,13 +431,129 @@ class Trainer:
                     and (last + 1) % self.cfg.checkpoint_frequency == 0):
                 ckpt.save(last + 1, params, opt_state)
             step += n
+        self._ckpt_unguard(old_handlers)
+        if (ckpt is not None and not interrupted
+                and self.cfg.train_steps > start_step):
+            ckpt.save(self.cfg.train_steps, params, opt_state)
+        return params, opt_state, history
+
+    def _ckpt_guard(self, workspace):
+        """(ckpt_manager, interrupted, old_handlers) — the shared
+        checkpoint + SIGTERM/SIGINT machinery of run()/run_cd().  Pair
+        with _ckpt_unguard(old_handlers)."""
+        ckpt = None
+        if workspace and self.cfg.checkpoint_frequency > 0:
+            from ..utils.checkpoint import CheckpointManager
+            ckpt = CheckpointManager(workspace)
+        interrupted: List[int] = []
+        old_handlers: Dict[Any, Any] = {}
+        if ckpt is not None:
+            import signal
+
+            def _on_signal(signum, frame):
+                interrupted.append(signum)
+
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    old_handlers[sig] = signal.signal(sig, _on_signal)
+                except ValueError:   # non-main thread: no signal hooks
+                    break
+        return ckpt, interrupted, old_handlers
+
+    @staticmethod
+    def _ckpt_unguard(old_handlers) -> None:
         if old_handlers:
             import signal
             for sig, h in old_handlers.items():
                 signal.signal(sig, h)
-        if (ckpt is not None and not interrupted
-                and self.cfg.train_steps > start_step):
-            ckpt.save(self.cfg.train_steps, params, opt_state)
+
+    def run_cd(self, params, opt_state, train_iter: Iterator,
+               start_step: int = 0, seed: int = 0,
+               workspace: Optional[str] = None):
+        """kContrastiveDivergence training (ModelProto.alg,
+        model.proto:40-44): greedy layer-wise CD-k over the net's kRBM
+        layers.  The training budget splits evenly across RBMs (classic
+        greedy stacking: each trains on the hidden probabilities of the
+        ones before it); the parser prefix and each RBM's Gibbs chain
+        run in one jitted step, updates through the ordinary Updater.
+        RBMProto.persistent runs PCD: the Gibbs chain continues from
+        the previous step's chain end instead of the data batch.
+        Checkpoint cadence and SIGTERM/SIGINT snapshots behave exactly
+        as in run() (PCD chain state is per-run and restarts from the
+        data on resume — standard PCD practice)."""
+        import functools
+
+        from ..models.rbm import cd_grads
+
+        net = self.train_net
+        rbm_names = [n for n in net.topo
+                     if getattr(net.layers[n], "is_rbm", False)]
+        if not rbm_names:
+            raise ValueError("alg kContrastiveDivergence needs at least "
+                             "one kRBM layer in the net")
+        mesh, cdtype = self.mesh, self.compute_dtype
+        updater, mults = self.updater, self.multipliers
+
+        @functools.partial(jax.jit, static_argnums=(4,))
+        def cd_step(params, opt_state, batch, rng, idx, step, chain):
+            name = rbm_names[idx]
+            layer = net.layers[name]
+            prefix = net.topo[:net.topo.index(name)]
+            _, _, outputs = net.apply(params, batch, train=False,
+                                      mesh=mesh, compute_dtype=cdtype,
+                                      layer_subset=prefix)
+            v = outputs[layer.cfg.srclayers[0]]
+            v = v.reshape(v.shape[0], -1).astype(jnp.float32)
+            grads, recon, chain_end = cd_grads(
+                layer.cd_view(params), v, rng, k=layer.cd_k,
+                persistent=chain)
+            named = layer.named_grads(grads)
+            sub_p = {k: params[k] for k in named}
+            sub_s = {sk: {k: sv[k] for k in named}
+                     for sk, sv in opt_state.items()}
+            sub_m = {k: mults[k] for k in named}
+            new_p, new_s = updater.update(step, named, sub_p, sub_s,
+                                          multipliers=sub_m)
+            params = {**params, **new_p}
+            opt_state = {sk: {**opt_state[sk], **new_s[sk]}
+                         for sk in opt_state}
+            return params, opt_state, recon, chain_end
+
+        total = self.cfg.train_steps
+        n = len(rbm_names)
+        rng = jax.random.PRNGKey(seed ^ 0xCD)
+        history: List[Dict[str, float]] = []
+        chains: Dict[int, Any] = {}   # PCD chain per RBM index
+        ckpt, interrupted, old_handlers = self._ckpt_guard(workspace)
+        step = start_step
+        for step in range(start_step, total):
+            if interrupted:
+                self.log(f"signal {interrupted[0]} received: "
+                         f"checkpointing at step {step} and stopping")
+                ckpt.save(step, params, opt_state)
+                break
+            idx = min(step * n // max(total, 1), n - 1)
+            layer = net.layers[rbm_names[idx]]
+            batch = next(train_iter)
+            params, opt_state, recon, chain_end = cd_step(
+                params, opt_state, batch, jax.random.fold_in(rng, step),
+                idx, step, chains.get(idx) if layer.persistent else None)
+            if layer.persistent:
+                chains[idx] = chain_end
+            self.perf.update({"recon": recon})
+            if self.display_now(step):
+                self.log(f"step-{step} cd[{rbm_names[idx]}]: "
+                         f"{self.perf.to_string()}")
+                history.append({"step": step, "rbm": idx,
+                                **self.perf.averages()})
+                self.perf.reset()
+            if (ckpt is not None and self.cfg.checkpoint_frequency > 0
+                    and step >= self.cfg.checkpoint_after_steps
+                    and (step + 1) % self.cfg.checkpoint_frequency == 0):
+                ckpt.save(step + 1, params, opt_state)
+        self._ckpt_unguard(old_handlers)
+        if ckpt is not None and not interrupted and total > start_step:
+            ckpt.save(total, params, opt_state)
         return params, opt_state, history
 
     def resume(self, params, opt_state, workspace: str):

@@ -144,6 +144,93 @@ def unroll_autoencoder(rbms: List[Dict[str, jnp.ndarray]]
     return params
 
 
+# ---------------------------------------------------------------------------
+# config surface: the kRBM layer + kContrastiveDivergence trainer hook
+
+
+def register_rbm_layer() -> None:
+    """Idempotent registration of the kRBM layer type (called lazily by
+    core.layers.create_layer, mirroring the seq_layers family)."""
+    from ..core.layers import (LAYER_REGISTRY, Layer, LayerError,
+                               register_layer)
+    if "kRBM" in LAYER_REGISTRY:
+        return
+
+    from ..core.seq_layers import _declare_with_default
+
+    @register_layer("kRBM")
+    class RBMLayer(Layer):
+        """Restricted Boltzmann machine layer (RBMProto: num_hidden,
+        cd_k, persistent).  Forward = hidden-unit probabilities
+        sigmoid(vW + bh) — the deterministic pass used for greedy
+        stacking and downstream layers; training runs the CD-k chain
+        through Trainer's kContrastiveDivergence path
+        (ModelProto.alg, model.proto:40-44), not backprop."""
+
+        is_rbm = True
+
+        def setup(self, src_shapes):
+            p = self.cfg.rbm_param
+            if p is None or not p.num_hidden:
+                raise LayerError(f"{self.name}: rbm_param.num_hidden "
+                                 "required")
+            s = tuple(src_shapes[0])
+            self.nvis = 1
+            for d in s[1:]:
+                self.nvis *= d
+            self.nhid = p.num_hidden
+            self.cd_k = max(p.cd_k, 1)
+            self.persistent = p.persistent
+            self.out_shape = (s[0], self.nhid)
+            self.w_key = _declare_with_default(
+                self, 0, "weight", (self.nvis, self.nhid), 0.01)
+            self.bv_key = _declare_with_default(
+                self, 1, "vbias", (self.nvis,), 0.0)
+            self.bh_key = _declare_with_default(
+                self, 2, "hbias", (self.nhid,), 0.0)
+
+        def cd_view(self, params):
+            """{W, bv, bh} view for cd_grads."""
+            return {"W": params[self.w_key], "bv": params[self.bv_key],
+                    "bh": params[self.bh_key]}
+
+        def named_grads(self, cd):
+            return {self.w_key: cd["W"], self.bv_key: cd["bv"],
+                    self.bh_key: cd["bh"]}
+
+        def apply(self, params, srcs, ctx):
+            v = srcs[0].reshape(srcs[0].shape[0], -1)
+            return _h_prob(self.cd_view(params), v)
+
+
+def rbm_mnist(widths: Sequence[int] = (250, 100), batchsize: int = 64,
+              train_steps: int = 2000, lr: float = 0.1, cd_k: int = 1):
+    """Config for greedy RBM pretraining on MNIST-shaped data — the
+    BASELINE's 'RBM / autoencoder pretraining (layer-wise greedy)'
+    entry as a declarative net (alg: kContrastiveDivergence)."""
+    from ..config.schema import model_config_from_dict
+    layers = [
+        {"name": "data", "type": "kShardData",
+         "data_param": {"batchsize": batchsize}},
+        {"name": "mnist", "type": "kMnistImage", "srclayers": "data",
+         "mnist_param": {"norm_a": 255.0}},
+    ]
+    src = "mnist"
+    for i, w in enumerate(widths):
+        layers.append({"name": f"rbm{i}", "type": "kRBM",
+                       "srclayers": src,
+                       "rbm_param": {"num_hidden": w, "cd_k": cd_k}})
+        src = f"rbm{i}"
+    return model_config_from_dict({
+        "name": "rbm-mnist", "train_steps": train_steps,
+        "display_frequency": 100,
+        "alg": "kContrastiveDivergence",
+        "updater": {"type": "kSGD", "base_learning_rate": lr,
+                    "momentum": 0.5,
+                    "learning_rate_change_method": "kFixed"},
+        "neuralnet": {"layer": layers}})
+
+
 def autoencoder_apply(params: Dict[str, jnp.ndarray], v: jnp.ndarray,
                       nlayers: int) -> jnp.ndarray:
     """Forward through the unrolled autoencoder (sigmoid units).  The

@@ -15,12 +15,13 @@ import argparse
 import os
 
 from singa_tpu.config import model_config_to_text
-from singa_tpu.models import vision
+from singa_tpu.models import rbm, vision
 
 
 EXAMPLES = {
     "mnist/mlp.conf": lambda: vision.mlp_mnist(),
     "mnist/conv.conf": lambda: vision.lenet_mnist(),
+    "mnist/rbm.conf": lambda: rbm.rbm_mnist(),
     "cifar10/quick.conf": lambda: vision.alexnet_cifar10(),
     "cifar10/alexnet.conf": lambda: vision.alexnet_cifar10_full(),
     "imagenet/alexnet.conf": lambda: vision.alexnet_imagenet(),

@@ -24,6 +24,17 @@ try:
 except Exception:  # pragma: no cover
     _HAVE_ORBAX = False
 
+# Parameter-layout generation.  Bump when a change re-orders elements
+# inside a stored parameter without changing its shape (such restores
+# would silently load permuted weights).  History:
+#   1 — NCHW vision stack (InnerProduct vdim ordered (C, H, W))
+#   2 — NHWC vision stack (vdim ordered (H, W, C), commit dd2e3aa)
+LAYOUT_VERSION = 2
+
+
+class LayoutMismatchError(RuntimeError):
+    pass
+
 
 class CheckpointManager:
     """Save/restore the training state triple under `workspace/checkpoints`
@@ -40,8 +51,39 @@ class CheckpointManager:
         else:
             self._mgr = None
 
+    def _version_path(self) -> str:
+        return os.path.join(self.dir, "LAYOUT_VERSION")
+
+    def _write_version(self) -> None:
+        with open(self._version_path(), "w") as f:
+            f.write(str(LAYOUT_VERSION))
+
+    def _check_version(self) -> None:
+        """Refuse to restore checkpoints written under a different
+        parameter layout: shapes match but element order does not
+        (e.g. the v1→v2 NCHW→NHWC InnerProduct vdim reorder), so a
+        silent restore would load permuted weights."""
+        path = self._version_path()
+        if not os.path.exists(path):
+            got = 1   # pre-versioning checkpoints are the v1 layout
+        else:
+            with open(path) as f:
+                got = int(f.read().strip() or 1)
+        if got != LAYOUT_VERSION:
+            raise LayoutMismatchError(
+                f"checkpoint layout version {got} != current "
+                f"{LAYOUT_VERSION}: parameters were stored with a "
+                f"different element order (see LAYOUT_VERSION history "
+                f"in singa_tpu/utils/checkpoint.py); re-train or "
+                f"convert the checkpoint")
+
     def save(self, step: int, params: Dict[str, Any],
              opt_state: Dict[str, Any]) -> None:
+        if self.latest_step() is not None:
+            # never mix layouts in one directory: saving v-current into
+            # a workspace still holding older-layout checkpoints would
+            # retroactively bless them (the marker is per-directory)
+            self._check_version()
         state = {"params": params, "opt_state": opt_state,
                  "step": np.asarray(step)}
         if self._mgr is not None:
@@ -51,6 +93,9 @@ class CheckpointManager:
             path = os.path.join(self.dir, f"step_{step}.npz")
             flat = _flatten("", state)
             np.savez(path, **{k: np.asarray(v) for k, v in flat.items()})
+        # stamp only after a successful save: a failed save must not
+        # mark the directory as holding current-layout checkpoints
+        self._write_version()
 
     def latest_step(self) -> Optional[int]:
         if self._mgr is not None:
@@ -66,6 +111,7 @@ class CheckpointManager:
         step = step if step is not None else self.latest_step()
         if step is None:
             return None
+        self._check_version()
         if self._mgr is not None:
             if template is not None:
                 target = {"params": template["params"],

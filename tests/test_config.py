@@ -117,3 +117,14 @@ def test_unknown_field_rejected():
 def test_bad_enum_rejected():
     with pytest.raises(ConfigError):
         model_config_from_text("updater { type: kBogus }")
+
+
+def test_textproto_dump_escapes_control_chars():
+    """dump() output with newlines/tabs/control chars in string values
+    must re-parse (protobuf text-format escaping; ADVICE r1)."""
+    from singa_tpu.config.textproto import dump, parse
+    msg = {"name": ['weird "x"\npath\twith\rctrl\x01'], "n": [3]}
+    text = dump(msg)
+    back = parse(text)
+    assert back["name"] == msg["name"]
+    assert back["n"] == [3]

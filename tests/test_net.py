@@ -366,3 +366,26 @@ def test_preemption_signal_checkpoints_and_resumes(tmp_path):
     assert signal.getsignal(signal.SIGTERM) in (
         signal.SIG_DFL, signal.default_int_handler) or callable(
         signal.getsignal(signal.SIGTERM))
+
+
+def test_checkpoint_layout_version_mismatch_refuses(tmp_path):
+    """A checkpoint written under a different parameter layout version
+    (or a pre-versioning one) must refuse to restore instead of loading
+    permuted weights (ADVICE r1: the NCHW->NHWC vdim reorder)."""
+    import os
+    import pytest
+    from singa_tpu.utils.checkpoint import (CheckpointManager,
+                                            LayoutMismatchError)
+
+    mgr = CheckpointManager(str(tmp_path))
+    params = {"w": jnp.ones((2, 2))}
+    opt = {"history": {"w": jnp.zeros((2, 2))}}
+    mgr.save(3, params, opt)
+    restored = mgr.restore(template={"params": params, "opt_state": opt})
+    assert restored is not None and restored[2] == 3
+
+    # simulate an old checkpoint: version marker absent
+    os.remove(os.path.join(mgr.dir, "LAYOUT_VERSION"))
+    with pytest.raises(LayoutMismatchError, match="layout version 1"):
+        CheckpointManager(str(tmp_path)).restore(
+            template={"params": params, "opt_state": opt})
