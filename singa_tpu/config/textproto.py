@@ -61,9 +61,11 @@ def _unquote(s: str) -> str:
         e = m.group(1)
         if e.startswith("x"):
             return chr(int(e[1:], 16))
+        if e[0] in "01234567":
+            return chr(int(e, 8))   # protoc emits octal \NNN escapes
         return {"n": "\n", "t": "\t", "r": "\r"}.get(e, e)
 
-    return re.sub(r"\\(x[0-9a-fA-F]{2}|.)", sub, body)
+    return re.sub(r"\\([0-7]{1,3}|x[0-9a-fA-F]{1,2}|.)", sub, body)
 
 
 def _escape(v: str) -> str:

@@ -45,6 +45,11 @@ class NeuralNet:
             l for l in net_cfg.layer if phase not in l.exclude]
         self.input_shapes = input_shapes or {}
         self.batchsize_override = batchsize
+        # NetProto.partition_type is the per-layer default;
+        # LayerProto.partition_type overrides it (neuralnet.cc:45-56,
+        # 198-323) — consumed as GSPMD sharding constraints in apply()
+        self.default_partition = net_cfg.partition_type
+        self._partition_warned: set = set()
 
         self.graph = Graph()
         for l in self.cfgs:
@@ -153,6 +158,56 @@ class NeuralNet:
         return {name: spec.partition_dim
                 for name, spec in self.param_specs.items()}
 
+    def layer_partition(self, name: str) -> str:
+        """Effective partition_type of a layer: LayerProto override,
+        else the NetProto default (neuralnet.cc:45-56)."""
+        lp = self.layers[name].cfg.partition_type
+        return lp if lp is not None else self.default_partition
+
+    def _constrain(self, out, name: str, mesh):
+        """GSPMD successor of the reference's connector insertion
+        (neuralnet.cc:198-323): a partition_type on a layer becomes a
+        sharding constraint on its activation —
+          kDataPartition  → batch dim over "data"
+          kLayerPartition → feature (last) dim over "model"
+          kNone           → fully replicated
+        and XLA compiles the Slice/Concate/Split/Bridge data movement
+        the reference hand-coded for every src→dst combination.  Falls
+        back (with a one-time warning) when the dim doesn't divide the
+        mesh axis — the reference instead gives the remainder to the
+        last partition (neuralnet.cc:160-162), which per-device static
+        shapes cannot express."""
+        import jax.numpy as _jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if mesh is None or not isinstance(out, _jnp.ndarray) or out.ndim == 0:
+            return out
+        ptype = self.layer_partition(name)
+        if ptype is None or ptype == "kNone":
+            return out
+        if ptype == "kDataPartition":
+            axis, dim = "data", 0
+        elif ptype == "kLayerPartition":
+            axis, dim = "model", out.ndim - 1
+        else:
+            return out
+        n = dict(mesh.shape).get(axis, 1)
+        if n <= 1:
+            return out
+        if out.shape[dim] % n:
+            if name not in self._partition_warned:
+                self._partition_warned.add(name)
+                import sys
+                print(f"warning: layer {name!r} {ptype} dim {dim} "
+                      f"(size {out.shape[dim]}) not divisible by mesh "
+                      f"axis {axis!r}={n}; activation stays replicated",
+                      file=sys.stderr)
+            return out
+        spec = [None] * out.ndim
+        spec[dim] = axis
+        return jax.lax.with_sharding_constraint(
+            out, NamedSharding(mesh, P(*spec)))
+
     def _resolve_params(self, params: Dict[str, jnp.ndarray]):
         if not self.param_aliases:
             return params
@@ -209,6 +264,7 @@ class NeuralNet:
                 )(*srcs)
             else:
                 out = layer.apply(full, srcs, ctx)
+            out = self._constrain(out, name, mesh)
             outputs[name] = out
             aux = getattr(layer, "_aux", None)
             if aux is not None:

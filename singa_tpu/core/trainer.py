@@ -343,6 +343,9 @@ class Trainer:
         last cadence checkpoint."""
         if self.cfg.alg == "kContrastiveDivergence":
             return self.run_cd(params, opt_state, train_iter,
+                               test_iter_factory=test_iter_factory,
+                               val_iter_factory=val_iter_factory,
+                               hooks=hooks, scan_chunk=scan_chunk,
                                start_step=start_step, seed=seed,
                                workspace=workspace)
         ckpt, interrupted, old_handlers = self._ckpt_guard(workspace)
@@ -468,6 +471,9 @@ class Trainer:
                 signal.signal(sig, h)
 
     def run_cd(self, params, opt_state, train_iter: Iterator,
+               test_iter_factory=None, val_iter_factory=None,
+               hooks: Optional[List[Callable[[int, Dict], None]]] = None,
+               scan_chunk: int = 0,
                start_step: int = 0, seed: int = 0,
                workspace: Optional[str] = None):
         """kContrastiveDivergence training (ModelProto.alg,
@@ -519,6 +525,17 @@ class Trainer:
                          for sk in opt_state}
             return params, opt_state, recon, chain_end
 
+        if scan_chunk and scan_chunk > 1:
+            self.log("warning: scan_chunk is not supported for CD "
+                     "training (host-side greedy phase switching); "
+                     "running per-step")
+        if (test_iter_factory or val_iter_factory) \
+                and self.test_step is None and self.val_step is None:
+            self.log("warning: test/validation iterators supplied but "
+                     "this CD net has no loss layer to evaluate; "
+                     "skipping (reconstruction error is the training "
+                     "metric)")
+
         total = self.cfg.train_steps
         n = len(rbm_names)
         rng = jax.random.PRNGKey(seed ^ 0xCD)
@@ -532,6 +549,19 @@ class Trainer:
                          f"checkpointing at step {step} and stopping")
                 ckpt.save(step, params, opt_state)
                 break
+            if (self.test_step and self.test_now(step)
+                    and test_iter_factory):
+                avg = self.evaluate(params, test_iter_factory(),
+                                    self.cfg.test_steps, self.test_step)
+                self.log(f"step-{step} test: " + ", ".join(
+                    f"{k} : {v:.6f}" for k, v in sorted(avg.items())))
+            if (self.val_step and self.validate_now(step)
+                    and val_iter_factory):
+                avg = self.evaluate(params, val_iter_factory(),
+                                    self.cfg.validation_steps,
+                                    self.val_step)
+                self.log(f"step-{step} validation: " + ", ".join(
+                    f"{k} : {v:.6f}" for k, v in sorted(avg.items())))
             idx = min(step * n // max(total, 1), n - 1)
             layer = net.layers[rbm_names[idx]]
             batch = next(train_iter)
@@ -541,6 +571,9 @@ class Trainer:
             if layer.persistent:
                 chains[idx] = chain_end
             self.perf.update({"recon": recon})
+            if hooks:
+                for h in hooks:
+                    h(step, {"recon": float(recon), "rbm": idx})
             if self.display_now(step):
                 self.log(f"step-{step} cd[{rbm_names[idx]}]: "
                          f"{self.perf.to_string()}")

@@ -47,18 +47,24 @@ def free_energy(params, v):
                       axis=-1))
 
 
-@functools.partial(jax.jit, static_argnames=("k", "persistent"))
 def cd_grads(params, v0, rng, k: int = 1,
              persistent: Optional[jnp.ndarray] = None,
              ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray, jnp.ndarray]:
     """CD-k gradients.  Returns (grads, reconstruction_error, chain_end).
 
     grads follow the *descent* convention (apply with params -= lr*grad)
-    so they plug into the Updater family directly.
+    so they plug into the Updater family directly.  `persistent` (a
+    traced array, PCD) supplies the Gibbs chain start; None starts from
+    the data batch.
     """
+    start = persistent if persistent is not None else v0
+    return _cd_grads(params, v0, rng, start, k)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _cd_grads(params, v0, rng, start, k: int):
     b = v0.shape[0]
     h0_prob = _h_prob(params, v0)
-    start = persistent if persistent is not None else v0
 
     def gibbs(carry, key):
         v, _ = carry

@@ -11,6 +11,15 @@ over ZMQ.
                     (gradient psum inserted by XLA at the loss reduce)
   kLayerPartition → param partition_dim sharded over "model"
                     (activations follow by propagation)
+
+Per-layer LayerProto.partition_type additionally becomes an activation
+sharding constraint inside NeuralNet.apply (net.py _constrain) — the
+9 src→dst connector cases of the reference partitioner fall out of
+GSPMD propagation between differently-constrained layers.  The
+reference's SetupAfterPartition hyperparameter rewriting
+(layer.cc:54-61) has no analogue by construction: layers here keep
+GLOBAL shapes (XLA's global-view semantics), so hyperparameters never
+change under partitioning.
 """
 
 from __future__ import annotations
@@ -32,7 +41,12 @@ def param_shardings(mesh: Mesh, net: NeuralNet,
                     tp_axis: str = "model") -> Dict[str, NamedSharding]:
     """Per-param NamedSharding from ParamProto.partition_dim + the layer
     defaults (weights partition on the neuron dim under kLayerPartition,
-    base_layer.h:121-128)."""
+    base_layer.h:121-128).  A param whose partition dim doesn't divide
+    the mesh axis replicates with a LOUD warning — a user asking for
+    tp=N on an indivisible width would otherwise silently get no
+    speedup and misattribute it."""
+    import sys
+
     out = {}
     for name, spec in net.param_specs.items():
         axis = spec.mesh_axis or tp_axis
@@ -43,8 +57,18 @@ def param_shardings(mesh: Mesh, net: NeuralNet,
             axes[dim] = axis
             out[name] = NamedSharding(mesh, P(*axes))
         else:
+            key = (name, axis, n)
+            if n > 1 and dim >= 0 and key not in _replication_warned:
+                _replication_warned.add(key)
+                print(f"warning: param {name!r} dim {dim} (size "
+                      f"{spec.shape[dim]}) not divisible by mesh axis "
+                      f"{axis!r}={n}; REPLICATING instead of sharding",
+                      file=sys.stderr)
             out[name] = replicated(mesh)
     return out
+
+
+_replication_warned: set = set()
 
 
 def batch_shardings(mesh: Mesh, batch_tree: Any,
