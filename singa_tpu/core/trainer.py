@@ -529,12 +529,14 @@ class Trainer:
             self.log("warning: scan_chunk is not supported for CD "
                      "training (host-side greedy phase switching); "
                      "running per-step")
-        if (test_iter_factory or val_iter_factory) \
-                and self.test_step is None and self.val_step is None:
-            self.log("warning: test/validation iterators supplied but "
-                     "this CD net has no loss layer to evaluate; "
-                     "skipping (reconstruction error is the training "
-                     "metric)")
+        for nm, it, step_fn in (("test", test_iter_factory, self.test_step),
+                                ("validation", val_iter_factory,
+                                 self.val_step)):
+            if it is not None and step_fn is None:
+                self.log(f"warning: {nm} iterator supplied but this CD "
+                         f"net built no {nm} eval step (no loss layer "
+                         f"in that phase); skipping {nm} evaluation "
+                         "(reconstruction error is the training metric)")
 
         total = self.cfg.train_steps
         n = len(rbm_names)

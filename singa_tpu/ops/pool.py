@@ -3,14 +3,25 @@
 Reference: layer.cc:476-540 — pooled = ceil((h - k)/s) + 1; AVE divides
 by k*k regardless of window clipping; MAX backward routes gradient to
 max positions (mshadow `unpool<red::maximum>`).  On TPU this is one
-`lax.reduce_window` (XLA lowers to a fused windowed reduction); the
-backward comes from autodiff, which reproduces unpool semantics.
+`lax.reduce_window` (XLA lowers to a fused windowed reduction).
+
+MAX backward: autodiff's select-and-scatter everywhere.  An
+equality-mask vjp (`_max_pool_nhwc`, kept below as the exact-parity
+form of mshadow's `unpool<red::maximum>`, tensor_expr_ext.h:148-163 —
+tied positions each receive the window's full gradient) was measured
+on chip in both tap-loop and phase-decomposed forms and LOST badly
+(187-198ms vs 132ms AlexNet step): under XLA's batch-in-lanes
+activation layouts, the strided/padded spatial shuffles it needs cost
+far more than the 7.8ms the fused select-and-scatter takes.  It stays
+available for semantics tests (ties), not for speed.
 """
 
 from __future__ import annotations
 
+import functools
 import math
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -47,6 +58,73 @@ def max_pool2d(x: jnp.ndarray, kernel: int, stride: int,
     # NOTE: init must be a weak-typed Python scalar — an Array init value
     # defeats reduce_window's autodiff rule.
     return lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pad)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _max_pool_nhwc(x, kernel, stride):
+    return _max_pool_nhwc_fwd(x, kernel, stride)[0]
+
+
+def _max_pool_nhwc_fwd(x, kernel, stride):
+    h, w = x.shape[1], x.shape[2]
+    ph, pw = _ceil_pad(h, kernel, stride), _ceil_pad(w, kernel, stride)
+    dims, strides, pad = _window(kernel, stride, ph, pw, "NHWC")
+    y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pad)
+    return y, (x, y)
+
+
+def _max_pool_nhwc_bwd(kernel, stride, res, g):
+    x, y = res
+    n, h, w, c = x.shape
+    oh_full, ow_full = y.shape[1], y.shape[2]
+    zero = jnp.zeros((), g.dtype)
+    yx = y.astype(x.dtype)
+    if h % stride == 0 and w % stride == 0:
+        # Phase decomposition: input position (pi + s·m) is covered only
+        # by taps ki ≡ pi (mod s), so each of the s² input phases sums
+        # ⌈k/s⌉² zero-padded output-space terms — all shapes output-
+        # sized, no strided scatters — and one stack/reshape interleaves
+        # the phases back.
+        hp, wp = h // stride, w // stride
+        rows = []
+        for pi in range(stride):
+            cols = []
+            for pj in range(stride):
+                xp = x[:, pi::stride, pj::stride, :]
+                acc = jnp.zeros((n, hp, wp, c), g.dtype)
+                for di in range((kernel - pi + stride - 1) // stride):
+                    ki = pi + di * stride
+                    oh = min(oh_full, (h - 1 - ki) // stride + 1)
+                    for dj in range((kernel - pj + stride - 1) // stride):
+                        kj = pj + dj * stride
+                        ow = min(ow_full, (w - 1 - kj) // stride + 1)
+                        hit = (xp[:, di:di + oh, dj:dj + ow, :]
+                               == yx[:, :oh, :ow, :])
+                        t = jnp.where(hit, g[:, :oh, :ow, :], zero)
+                        acc = acc + jnp.pad(
+                            t, ((0, 0), (di, hp - di - oh),
+                                (dj, wp - dj - ow), (0, 0)))
+                cols.append(acc)
+            rows.append(jnp.stack(cols, axis=3))       # (N,hp,wp,s,C)
+        dx = jnp.stack(rows, axis=2)                   # (N,hp,s,wp,s,C)
+        return (dx.reshape(n, h, w, c),)
+    dx = jnp.zeros_like(x)
+    for ki in range(kernel):
+        # windows whose tap ki lands inside the unpadded input
+        oh = min(oh_full, (h - 1 - ki) // stride + 1)
+        hi = ki + (oh - 1) * stride + 1
+        for kj in range(kernel):
+            ow = min(ow_full, (w - 1 - kj) // stride + 1)
+            wj = kj + (ow - 1) * stride + 1
+            sl = (slice(None), slice(ki, hi, stride),
+                  slice(kj, wj, stride), slice(None))
+            hit = x[sl] == yx[:, :oh, :ow, :]
+            dx = dx.at[sl].add(
+                jnp.where(hit, g[:, :oh, :ow, :], zero))
+    return (dx,)
+
+
+_max_pool_nhwc.defvjp(_max_pool_nhwc_fwd, _max_pool_nhwc_bwd)
 
 
 def avg_pool2d(x: jnp.ndarray, kernel: int, stride: int,

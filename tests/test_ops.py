@@ -345,3 +345,50 @@ def test_mnist_layer_applies_distortion_only_in_train():
     np.testing.assert_allclose(np.asarray(out_eval["mnist"]), plain,
                                atol=1e-6)
     assert float(jnp.max(jnp.abs(out_train["mnist"] - plain))) > 1e-4
+
+
+def test_lrn_pallas_interpret_matches_band_path():
+    """The Pallas batch-in-lanes LRN kernels (ops/lrn_pallas.py) against
+    the production jnp band-matmul custom_vjp, in interpreter mode on
+    the CPU test platform.  (On chip the kernels measured slower than
+    XLA's fused band-dot emitter and are not selected — see
+    ops/lrn.py:_impl_for — but they remain the independent oracle for
+    the closed-form backward and the benchmark baseline for
+    tools/ablate.py.)"""
+    from singa_tpu.ops.lrn import _lrn_nhwc
+    from singa_tpu.ops.lrn_pallas import eligible
+
+    x = jnp.asarray(RNG.standard_normal((128, 4, 4, 8)).astype(np.float32))
+    g = jnp.asarray(RNG.standard_normal((128, 4, 4, 8)).astype(np.float32))
+    assert eligible(x)
+    for relu in (False, True):
+        args = (3, 5e-3, 0.75, 1.0, relu)
+        y_j, vjp_j = jax.vjp(lambda t: _lrn_nhwc(t, *args, "jnp"), x)
+        y_p, vjp_p = jax.vjp(lambda t: _lrn_nhwc(t, *args, "interpret"), x)
+        np.testing.assert_allclose(y_p, y_j, atol=1e-5)
+        np.testing.assert_allclose(vjp_p(g)[0], vjp_j(g)[0], atol=1e-5)
+    # non-lane-multiple batch is not eligible
+    assert not eligible(jnp.zeros((100, 4, 4, 8)))
+
+
+def test_maxpool_equality_mask_vjp_ties_match_reference():
+    """_max_pool_nhwc routes gradient to EVERY tied max (mshadow
+    unpool<red::maximum> semantics, tensor_expr_ext.h:148-163): with a
+    constant input, every window position compares equal to the max and
+    receives the window's full cotangent — unlike select-and-scatter,
+    which picks a single winner."""
+    from singa_tpu.ops.pool import _max_pool_nhwc
+
+    x = jnp.ones((1, 4, 4, 1), np.float32)
+    y, vjp = jax.vjp(lambda t: _max_pool_nhwc(t, 2, 2), x)
+    (dx,) = vjp(jnp.ones_like(y))
+    # 2x2 stride-2 windows: every input position ties -> grad 1 each
+    np.testing.assert_allclose(dx, np.ones((1, 4, 4, 1)))
+    # and on untied data it matches autodiff of the NCHW path
+    xr = jnp.asarray(RNG.standard_normal((2, 8, 8, 3)).astype(np.float32))
+    cot = jnp.asarray(RNG.standard_normal((2, 4, 4, 3)).astype(np.float32))
+    _, vjp_em = jax.vjp(lambda t: _max_pool_nhwc(t, 3, 2), xr)
+    _, vjp_ad = jax.vjp(
+        lambda t: ops.max_pool2d(t.transpose(0, 3, 1, 2), 3, 2,
+                                 "NCHW").transpose(0, 2, 3, 1), xr)
+    np.testing.assert_allclose(vjp_em(cot)[0], vjp_ad(cot)[0], atol=1e-6)
