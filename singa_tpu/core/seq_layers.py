@@ -26,6 +26,9 @@ from ..ops.attention import (attention_reference, expand_kv_heads,
                              flash_attention, rope)
 from .layers import Layer, LayerError, register_layer
 
+# attention layers that already warned about the dense-fallback path
+_flash_fallback_warned: set = set()
+
 
 def _gaussian(std: float) -> ParamConfig:
     return ParamConfig(init_method="kGaussain", mean=0.0, std=std)
@@ -201,6 +204,14 @@ class AttentionLayer(Layer):
         elif s % 128 == 0 and self.head_dim % 8 == 0:
             out = flash_attention(q, k, v, self.causal)
         else:
+            if self.cfg.name not in _flash_fallback_warned:
+                _flash_fallback_warned.add(self.cfg.name)
+                import sys
+                print(f"warning: attention layer {self.cfg.name!r} "
+                      f"(seq_len={s}, head_dim={self.head_dim}) falls "
+                      f"back to dense O(S^2)-memory attention — the "
+                      f"flash kernel needs seq_len % 128 == 0 and "
+                      f"head_dim % 8 == 0", file=sys.stderr)
             out = attention_reference(q, k, v, self.causal)
         out = out.transpose(0, 2, 1, 3).reshape(b, s, -1)
         return self._proj(params, self.wo, out.astype(x.dtype), ctx)
@@ -372,6 +383,7 @@ class LMHeadLossLayer(Layer, _HeadProjection):
         self.tied = bool(self.cfg.share_param)
         self.w_key = _declare_with_default(
             self, 0, "w", (e, p.vocab_size), 1.0 / math.sqrt(e), 1)
+        self.flops_shape = (b, s, e, p.vocab_size)   # for utils.flops
         self.out_shape = (2,)
 
     def apply(self, params, srcs, ctx):

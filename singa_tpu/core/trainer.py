@@ -117,6 +117,16 @@ class Trainer:
         self._build_steps(donate)
         self.perf = Performance()
         self.timer = TimerInfo()
+        for nm, freq, steps in (
+                ("test", model_cfg.test_frequency, model_cfg.test_steps),
+                ("validation", model_cfg.validation_frequency,
+                 model_cfg.validation_steps)):
+            if freq > 0 and steps <= 0:
+                self.log(f"warning: {nm}_frequency is set but "
+                         f"{nm}_steps is 0 — no {nm} net is built and "
+                         f"{nm} evaluation will not run (the reference "
+                         f"gates eval nets on the step count, "
+                         f"worker.cc:16-27)")
 
     def _maybe_pipeline(self, n_micro: int) -> Dict[int, Any]:
         """{id(net): PipelineNet} when the config marks stages AND the
@@ -144,17 +154,61 @@ class Trainer:
         return net.apply if pnet is None else pnet.apply
 
     def _maybe_net(self, phase: str, input_shapes) -> Optional[NeuralNet]:
-        try:
-            net = build_net(self.cfg, phase, input_shapes)
-        except Exception:
+        """Build the eval net for `phase`, or None when the phase is not
+        configured.  Mirrors the reference Worker, which builds the
+        test/validation nets only when their step counts are set
+        (worker.cc:16-27: `if(model.test_steps()) SetupNeuralNet(kTest)`)
+        — e.g. conv.conf's two same-named per-phase data layers exclude
+        kTrain/kTest but not kValidation, so a kValidation build would
+        see duplicate nodes; with validation unconfigured it is never
+        attempted.  A phase whose filtered layers lack a data or loss
+        layer is also legitimately absent, but a CONFIGURED phase that
+        fails to build (typo'd srclayer, bad shapes) raises instead of
+        silently disabling evaluation (round-1 review: the old bare
+        `except Exception` swallowed real config errors)."""
+        steps = (self.cfg.test_steps if phase == "kTest"
+                 else self.cfg.validation_steps)
+        if steps <= 0:
             return None
+        from .layers import LAYER_REGISTRY
+        cfgs = [l for l in self.cfg.neuralnet.layer if phase not in l.exclude]
+        has_data = any(getattr(LAYER_REGISTRY.get(l.type), "is_data", False)
+                       for l in cfgs)
+        has_loss = any(getattr(LAYER_REGISTRY.get(l.type), "is_loss", False)
+                       for l in cfgs)
+        if not (has_data and has_loss):
+            return None
+        net = build_net(self.cfg, phase, input_shapes)
         return net if net._loss_layers() else None
 
     # -- compiled steps ----------------------------------------------------
+    #: TPU compiler options for conv-family step programs.  The
+    #: scoped-VMEM budget (default 16MB) caps XLA's fusion depth; 96MB
+    #: measured 136ms -> 128ms on the AlexNet gate workload (bigger
+    #: conv/LRN fusions stop splitting), while 128MB tips into
+    #: catastrophic spills (2.8s/step) — swept on a v5e chip
+    #: (tools/xla_flag_sweep.py ran the env-flag variant; the working
+    #: path is jit(compiler_options=...), which the axon compile helper
+    #: forwards per-compile).  The transformer family REGRESSES under
+    #: the raised budget (0.201 -> 0.179 MFU — it shrinks the VMEM left
+    #: to the Pallas flash kernels), so the option applies only to nets
+    #: with convolution layers.
+    TPU_CONV_COMPILER_OPTIONS = {"xla_tpu_scoped_vmem_limit_kib": "98304"}
+
+    def _compiler_options(self):
+        from ..ops.attention import _on_tpu
+        if not _on_tpu():
+            return None
+        has_conv = any(l.cfg.type == "kConvolution"
+                       for l in self.train_net.layers.values())
+        return (dict(self.TPU_CONV_COMPILER_OPTIONS) or None) \
+            if has_conv else None
+
     def _build_steps(self, donate: bool) -> None:
         net, updater, mults = self.train_net, self.updater, self.multipliers
         mesh, cdtype = self.mesh, self.compute_dtype
         net_apply = self._net_apply(net)
+        copts = self._compiler_options()
 
         def train_step(params, opt_state, batch, step, rng):
             def loss_fn(p):
@@ -169,7 +223,8 @@ class Trainer:
             return params, opt_state, metrics
 
         donate_args = (0, 1) if donate else ()
-        self.train_step = jax.jit(train_step, donate_argnums=donate_args)
+        self.train_step = jax.jit(train_step, donate_argnums=donate_args,
+                                  compiler_options=copts)
 
         def train_scan(params, opt_state, batches, start_step, rng, nsteps,
                        stacked=False):
@@ -215,7 +270,8 @@ class Trainer:
             return params, opt_state, metrics
 
         self.train_steps = jax.jit(train_scan, static_argnums=(5, 6),
-                                   donate_argnums=donate_args)
+                                   donate_argnums=donate_args,
+                                   compiler_options=copts)
 
         def make_eval(net):
             apply_fn = self._net_apply(net)
@@ -224,7 +280,7 @@ class Trainer:
                 _, metrics, _ = apply_fn(params, batch, train=False,
                                          mesh=mesh, compute_dtype=cdtype)
                 return metrics
-            return jax.jit(eval_step)
+            return jax.jit(eval_step, compiler_options=copts)
 
         self.test_step = make_eval(self.test_net) if self.test_net else None
         self.val_step = make_eval(self.val_net) if self.val_net else None
@@ -241,7 +297,8 @@ class Trainer:
                 loss_fn, has_aux=True)(params)
             return outputs, grads
 
-        self.debug_step = jax.jit(debug_step) if self.cfg.debug else None
+        self.debug_step = (jax.jit(debug_step, compiler_options=copts)
+                           if self.cfg.debug else None)
 
     # -- init --------------------------------------------------------------
     def init(self, seed: int = 0):

@@ -236,6 +236,8 @@ def lenet_mnist(batchsize: int = 64, train_steps: int = 10000) -> ModelConfig:
     return model_config_from_dict({
         "name": "lenet-mnist",
         "train_steps": train_steps,
+        # test cadence mirrors the reference conv.conf:3-4
+        "test_steps": 100, "test_frequency": 500,
         "display_frequency": 100,
         "updater": {"type": "kSGD", "base_learning_rate": 0.01,
                     "momentum": 0.9, "weight_decay": 0.0005,
@@ -268,6 +270,8 @@ def mlp_mnist(batchsize: int = 1000, train_steps: int = 60000,
     return model_config_from_dict({
         "name": "deep-big-simple-mlp",
         "train_steps": train_steps,
+        # test cadence mirrors the reference mlp.conf:3-4
+        "test_steps": 10, "test_frequency": 30,
         "display_frequency": 30,
         # the reference's mlp.conf runs the Elastic-averaging consistency
         # tier (mlp.conf:12-16): sync with the center every 8 steps

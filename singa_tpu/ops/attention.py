@@ -54,8 +54,8 @@ def attention_reference(q, k, v, causal: bool = True,
 # Pallas flash kernel
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
-                  *, causal: bool, scale: float, block_q: int,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
+                  acc_ref, *, causal: bool, scale: float, block_q: int,
                   block_k: int):
     iq = pl.program_id(1)
     ik = pl.program_id(2)
@@ -74,11 +74,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            qpos = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+            s = jnp.where(_causal_mask_block(iq, ik, block_q, block_k),
+                          s, NEG_INF)
         m_prev = m_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -98,12 +95,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
 
     @pl.when(ik == nk - 1)
     def _finalize():
-        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
-                    ).astype(o_ref.dtype)
+        l_safe = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        # lse rides as (bh, sq, 1): trailing singleton keeps the block's
+        # last-two dims (bq, 1) Mosaic-legal ((1, bq) is not)
+        lse_ref[0] = m_ref[:] + jnp.log(l_safe)
 
 
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
                    interpret: bool):
+    """Returns (out, lse); lse (B, H, S) feeds the Pallas backward."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bq, bk = min(block_q, sq), min(block_k, sk)
@@ -114,7 +115,7 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     kr = k.reshape(b * h, sk, d)
     vr = v.reshape(b * h, sk, d)
     grid = (b * h, sq // bq, sk // bk)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_flash_kernel, causal=causal, scale=scale,
                           block_q=bq, block_k=bk),
         grid=grid,
@@ -123,8 +124,14 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, bk, d), lambda bh, iq, ik: (bh, ik, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, iq, ik: (bh, ik, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, iq, ik: (bh, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, iq, ik: (bh, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -132,7 +139,162 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
         ],
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(b, h, sq, d)
+    return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+
+
+def _causal_mask_block(iq, ik, block_q, block_k):
+    qpos = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    kpos = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return qpos >= kpos
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+                         dq_ref, acc_ref, *, causal, scale, block_q,
+                         block_k):
+    """dq = τ·Σ_k ds·k, accumulated over kv blocks (innermost grid dim)."""
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = jnp.where(_causal_mask_block(iq, ik, block_q, block_k),
+                          s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0])        # lse block (bq, 1) broadcasts
+        do = do_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - dl_ref[0])
+        acc_ref[:] = acc_ref[:] + jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(ik * block_k <= (iq + 1) * block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ik == nk - 1)
+    def _done():
+        dq_ref[0] = (acc_ref[:] * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, causal,
+                          scale, block_q, block_k):
+    """dv = Σ_q pᵀ·do and dk = τ·Σ_q dsᵀ·q, accumulated over q blocks
+    (innermost grid dim)."""
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = jnp.where(_causal_mask_block(iq, ik, block_q, block_k),
+                          s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0])        # lse block (bq, 1) broadcasts
+        do = do_ref[0].astype(jnp.float32)
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - dl_ref[0])
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(ik * block_k <= (iq + 1) * block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(iq == nq - 1)
+    def _done():
+        dk_ref[0] = (dk_acc[:] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, do, causal, block_q, block_k,
+                    interpret):
+    """FlashAttention backward via the two Pallas kernels above.
+
+    delta = rowsum(do·out) (the D term) is a cheap fused jnp op; the
+    kernels then recompute p per tile from (q, k, lse) — the S×S score
+    matrix never exists in HBM, matching the forward's memory profile,
+    and every matmul (p, dp, ds·k, dsᵀ·q, pᵀ·do) rides the MXU.
+    """
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq, bk = min(block_q, sq), min(block_k, sk)
+    scale = 1.0 / math.sqrt(d)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                              # (B, H, Sq)
+    qr = q.reshape(b * h, sq, d)
+    kr = k.reshape(b * h, sk, d)
+    vr = v.reshape(b * h, sk, d)
+    dor = do.reshape(b * h, sq, d).astype(q.dtype)
+    lser = lse.reshape(b * h, sq, 1)
+    dr = delta.reshape(b * h, sq, 1)
+
+    q_spec = pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0))
+    k_spec = pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0))
+    r_spec = pl.BlockSpec((1, bq, 1), lambda bh, i, j: (bh, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, causal=causal,
+                          scale=scale, block_q=bq, block_k=bk),
+        grid=(b * h, sq // bq, sk // bk),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, dr)
+
+    # dkv grid: kv block outer, q block inner (accumulation dim)
+    q_spec2 = pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0))
+    k_spec2 = pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0))
+    r_spec2 = pl.BlockSpec((1, bq, 1), lambda bh, j, i: (bh, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, causal=causal,
+                          scale=scale, block_q=bq, block_k=bk),
+        grid=(b * h, sk // bk, sq // bq),
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2],
+        out_specs=[k_spec2, k_spec2],
+        out_shape=[jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, sk, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, dr)
+    return (dq.reshape(q.shape), dk.reshape(k.shape),
+            dv.reshape(v.shape))
 
 
 def _on_tpu() -> bool:
@@ -152,11 +314,12 @@ def _on_tpu() -> bool:
 def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
                     block_k: int = 128, interpret: Optional[bool] = None):
     """FlashAttention. q/k/v: (B, H, S, D).  On non-TPU backends (or with
-    interpret=True) the Pallas kernel runs interpreted; backward is
-    blockwise rematerialization."""
+    interpret=True) the Pallas kernels run interpreted.  Backward is the
+    hand-written dq/dkv Pallas kernel pair (_flash_backward) — tilewise
+    recompute from (q, k, lse), every matmul on the MXU."""
     if interpret is None:
         interpret = not _on_tpu()
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)[0]
 
 
 def chunk_attention(q, k, v, causal: bool, q_off, kv_off):
@@ -188,6 +351,39 @@ def merge_attention(out1, lse1, out2, lse2):
     lse = jnp.logaddexp(jnp.maximum(lse1, NEG_INF),
                         jnp.maximum(lse2, NEG_INF))
     return out1 * jnp.exp(lse1 - lse) + out2 * jnp.exp(lse2 - lse), lse
+
+
+def chunk_attention_blockwise(q, k, v, causal: bool, q_off, kv_off,
+                              block_k: int = 512):
+    """chunk_attention with flash-style memory: the KV chunk is scanned
+    in `block_k` sub-blocks with online log-sum-exp merging and
+    jax.checkpoint per sub-block, so peak memory is O(Sq·block_k)
+    instead of O(Sq·Sk).  Same (normalized out, lse) contract and same
+    autodiff path as chunk_attention — ring attention
+    (singa_tpu.parallel.sequence) calls this for its local step so the
+    per-rotation score matrix never materializes at full chunk size."""
+    b, h, sk, d = k.shape
+    if sk <= block_k or sk % block_k:
+        return chunk_attention(q, k, v, causal, q_off, kv_off)
+    nb = sk // block_k
+    kb = k.reshape(b, h, nb, block_k, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(b, h, nb, block_k, d).transpose(2, 0, 1, 3, 4)
+
+    @jax.checkpoint
+    def sub(q, kc, vc, off):
+        return chunk_attention(q, kc, vc, causal, q_off, off)
+
+    def step(carry, blk):
+        out, lse = carry
+        kc, vc, i = blk
+        o_new, l_new = sub(q, kc, vc, kv_off + i * block_k)
+        return merge_attention(out, lse, o_new, l_new), None
+
+    out0 = jnp.zeros(q.shape, jnp.float32)
+    lse0 = jnp.full(q.shape[:3] + (1,), NEG_INF, jnp.float32)
+    (out, lse), _ = jax.lax.scan(step, (out0, lse0),
+                                 (kb, vb, jnp.arange(nb)))
+    return out, lse
 
 
 def blockwise_attention(q, k, v, causal: bool = True, block_k: int = 512):
@@ -222,19 +418,18 @@ def blockwise_attention(q, k, v, causal: bool = True, block_k: int = 512):
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    if interpret is None:
+        interpret = not _on_tpu()
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    # Blockwise recompute: grads come from the O(S·block)-memory
-    # formulation — the full (S,S) score matrix is never materialized,
-    # matching the flash forward's memory profile.
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal),
-        q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _flash_backward(q, k, v, out, lse, g, causal, block_q,
+                           block_k, interpret)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

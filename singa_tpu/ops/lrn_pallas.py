@@ -135,6 +135,9 @@ def _from_lanes(y, n, h, w, c):
 
 def lrn_fwd_pallas(x, local_size: int, alpha: float, beta: float,
                    knorm: float, relu: bool, interpret: bool = False):
+    if not eligible(x):
+        raise ValueError(f"lrn_pallas needs N%128==0 and C%8==0; got "
+                         f"{x.shape} {x.dtype}")
     n, h, w, c = x.shape
     band = jnp.asarray(_np_band(c, local_size), x.dtype)
     kern = functools.partial(
@@ -147,6 +150,9 @@ def lrn_fwd_pallas(x, local_size: int, alpha: float, beta: float,
 
 def lrn_bwd_pallas(x, g, local_size: int, alpha: float, beta: float,
                    knorm: float, relu: bool, interpret: bool = False):
+    if not eligible(x):
+        raise ValueError(f"lrn_pallas needs N%128==0 and C%8==0; got "
+                         f"{x.shape} {x.dtype}")
     n, h, w, c = x.shape
     band = jnp.asarray(_np_band(c, local_size), x.dtype)
     kern = functools.partial(

@@ -24,8 +24,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
-from ..ops.attention import (NEG_INF, attention_reference, chunk_attention,
-                             merge_attention)
+from ..ops.attention import (NEG_INF, attention_reference,
+                             chunk_attention_blockwise, merge_attention)
 
 
 def _spec(mesh: Mesh, seq_axis: str, heads: int):
@@ -52,8 +52,10 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
         def step(carry, s):
             k_cur, v_cur, out, lse = carry
             src = jax.lax.rem(idx - s + nseq, nseq)  # owner of current kv
-            o_new, lse_new = chunk_attention(q, k_cur, v_cur, causal,
-                                             q_off, src * chunk)
+            # chunked-flash local step: the per-rotation score matrix
+            # stays O(chunk·block) even for long local KV chunks
+            o_new, lse_new = chunk_attention_blockwise(
+                q, k_cur, v_cur, causal, q_off, src * chunk)
             out, lse = merge_attention(out, lse, o_new, lse_new)
             # rotate kv to the next device (ring over ICI)
             perm = [(i, (i + 1) % nseq) for i in range(nseq)]

@@ -78,14 +78,58 @@ def _linear_flops(layer) -> int:
     return 2 * n * vdim * hdim
 
 
+def _attention_flops(layer) -> int:
+    b, s, e = layer.out_shape
+    hd = layer.heads * layer.head_dim
+    kvd = layer.kv_heads * layer.head_dim
+    proj = 2 * b * s * e * (hd + 2 * kvd + hd)        # wq wk wv wo
+    scores = 4 * b * layer.heads * s * s * layer.head_dim   # qk + pv
+    if layer.causal:
+        scores //= 2       # flash kernels skip fully-masked blocks
+    return proj + scores
+
+
+def _ffn_flops(layer) -> int:
+    b, s, e = layer.out_shape
+    f = layer.param_specs[0].shape[1]                 # w1 (E, F)
+    mats = 3 if getattr(layer, "gated", False) else 2
+    return 2 * b * s * e * f * mats
+
+
+def _moe_flops(layer) -> int:
+    b, s, e = layer.out_shape
+    f = layer.param_specs[1].shape[2]                 # w1 (n_exp, E, F)
+    router = 2 * b * s * e * layer.n_exp
+    # each token runs k experts' (E→F→E) MLP (capacity overflow drops
+    # are data-dependent; count the routed budget)
+    return router + 2 * b * s * layer.k * 2 * e * f
+
+
+def _lm_head_flops(layer) -> int:
+    if layer.cfg.type == "kLMHeadLoss":
+        b, s, e, v = layer.flops_shape
+    else:
+        b, s, v = layer.out_shape
+        e = layer.param_specs[0].shape[0]       # w (E, V), tied or not
+    return 2 * b * s * e * v
+
+
 def layer_forward_flops(layer) -> int:
     """Matmul/conv FLOPs of one layer's forward; 0 for non-MXU layers
-    (elementwise/pool/LRN are bandwidth-, not FLOP-, dominated)."""
+    (elementwise/pool/LRN/norm are bandwidth-, not FLOP-, dominated)."""
     t = layer.cfg.type
     if t == "kConvolution":
         return _conv_flops(layer)
     if t == "kInnerProduct":
         return _linear_flops(layer)
+    if t == "kAttention":
+        return _attention_flops(layer)
+    if t == "kFeedForward":
+        return _ffn_flops(layer)
+    if t == "kMoE":
+        return _moe_flops(layer)
+    if t in ("kLMHead", "kLMHeadLoss"):
+        return _lm_head_flops(layer)
     return 0
 
 

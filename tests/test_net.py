@@ -389,3 +389,61 @@ def test_checkpoint_layout_version_mismatch_refuses(tmp_path):
     with pytest.raises(LayoutMismatchError, match="layout version 1"):
         CheckpointManager(str(tmp_path)).restore(
             template={"params": params, "opt_state": opt})
+
+
+def test_maybe_net_raises_on_broken_eval_phase():
+    """A typo'd srclayer in the test phase must FAIL Trainer
+    construction, not silently disable evaluation (round-1 review: the
+    old bare `except Exception` in _maybe_net swallowed real config
+    errors)."""
+    from singa_tpu.core.layers import LayerError
+    from singa_tpu.core.trainer import Trainer
+    from singa_tpu.models.vision import lenet_mnist
+
+    cfg = lenet_mnist(batchsize=4)
+    # an extra kTest-only layer pointing at a layer that doesn't exist
+    from singa_tpu.config.schema import model_config_from_dict
+    d = {"name": "broken", "train_steps": 1, "test_steps": 5,
+         "test_frequency": 1,
+         "updater": {"type": "kSGD", "base_learning_rate": 0.01},
+         "neuralnet": {"layer": [
+             {"name": "data", "type": "kShardData",
+              "data_param": {"batchsize": 4}},
+             {"name": "mnist", "type": "kMnistImage", "srclayers": "data"},
+             {"name": "label", "type": "kLabel", "srclayers": "data"},
+             {"name": "ip", "type": "kInnerProduct", "srclayers": "mnist",
+              "inner_product_param": {"num_output": 10},
+              "param": [{"name": "weight", "init_method": "kUniform",
+                         "low": -0.1, "high": 0.1},
+                        {"name": "bias", "init_method": "kConstant"}]},
+             {"name": "bad", "type": "kReLU", "srclayers": "nope",
+              "exclude": ["kTrain", "kValidation"]},
+             {"name": "loss", "type": "kSoftmaxLoss",
+              "srclayers": ["ip", "label"]},
+         ]}}
+    with pytest.raises(LayerError, match="nope"):
+        Trainer(model_config_from_dict(d),
+                {"data": {"pixel": (28, 28), "label": ()}},
+                log_fn=lambda s: None)
+    # sanity: the clean config (with test cadence on) still builds
+    cfg.test_steps = 10
+    tr = Trainer(cfg, {"data": {"pixel": (28, 28), "label": ()}},
+                 log_fn=lambda s: None)
+    assert tr.test_step is not None
+
+
+def test_maybe_net_none_when_phase_has_no_loss():
+    """A phase whose filtered layers lack a loss layer is legitimately
+    absent — Trainer builds no eval step and raises nothing."""
+    from singa_tpu.core.trainer import Trainer
+    from singa_tpu.models.vision import lenet_mnist
+
+    cfg = lenet_mnist(batchsize=4)
+    cfg.test_steps = 10
+    cfg.validation_steps = 10
+    for l in cfg.neuralnet.layer:
+        if l.type == "kSoftmaxLoss":
+            l.exclude = ["kTest", "kValidation"]
+    tr = Trainer(cfg, {"data": {"pixel": (28, 28), "label": ()}},
+                 log_fn=lambda s: None)
+    assert tr.test_step is None and tr.val_step is None

@@ -65,6 +65,10 @@ def test_pipeline_net_matches_unpipelined():
 def test_pipeline_eval_matches_and_flat_mesh_inert():
     mesh = make_mesh(jax.devices(), data=2, pipe=4, model=1)
     cfg_p = transformer_lm(pipeline_stages=4, **CFG)
+    # eval nets are built only when the test cadence is configured
+    # (worker.cc:16-27 semantics — see Trainer._maybe_net)
+    cfg_p.test_steps = 1
+    cfg_p.test_frequency = 100
     batch = _batch()
     tr_p = Trainer(cfg_p, SHAPES, log_fn=lambda s: None, donate=False,
                    mesh=mesh)

@@ -92,7 +92,6 @@ def main():
     args = ap.parse_args()
 
     from singa_tpu.models.vision import alexnet_cifar10_full
-    from singa_tpu.utils.flops import net_train_flops, peak_flops
 
     base_cfg = alexnet_cifar10_full(batchsize=args.batch)
     ave_cfg = copy.deepcopy(base_cfg)
