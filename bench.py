@@ -114,7 +114,7 @@ def _cifar_mfu(cfg, batch_size, iters, reps, precision):
     return mfu(flops, step_s), step_s, flops
 
 
-def bench_alexnet_mfu(batch_size=8192, iters=10, reps=4,
+def bench_alexnet_mfu(batch_size=8192, iters=10, reps=6,
                       precision="bfloat16"):
     """North-star gate 2 (the judged stdout metric)."""
     from singa_tpu.models.vision import alexnet_cifar10_full
@@ -250,7 +250,9 @@ def main() -> None:
         primary["transformer_lm_mfu_error"] = repr(e)
     print(json.dumps(primary))
     if "--extra" in sys.argv:
-        for fn in (bench_lenet, bench_quick_mfu, bench_transformer_mfu):
+        # transformer MFU is not repeated here: main() already ran it
+        # for the primary line's aux keys
+        for fn in (bench_lenet, bench_quick_mfu):
             try:
                 print(json.dumps(fn()), file=sys.stderr)
             except Exception as e:  # secondary metrics must not break

@@ -78,8 +78,16 @@ def chunked_lm_xent(h: jnp.ndarray, w: jnp.ndarray, labels: jnp.ndarray,
         logits = jnp.dot(hc, w, preferred_element_type=jnp.float32)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         ll = jnp.take_along_axis(logits, lc[:, None], axis=-1)[:, 0]
-        _, idx = jax.lax.top_k(logits, topk)
-        hits = jnp.any(idx == lc[:, None], axis=-1)
+        if topk == 1:
+            # top-1 via argmax: lax.top_k is a sort-based custom call
+            # costing ~7ms/step at V=32k on the bench stack.  argmax
+            # keeps top_k's tie-break exactly (lowest index wins), so
+            # degenerate rows don't inflate the metric the way a
+            # "label logit >= row max" compare would.
+            hits = jnp.argmax(logits, axis=-1) == lc
+        else:
+            _, idx = jax.lax.top_k(logits, topk)
+            hits = jnp.any(idx == lc[:, None], axis=-1)
         return jnp.sum(lse - ll), jnp.sum(hits.astype(jnp.float32))
 
     def step(carry, xs):
