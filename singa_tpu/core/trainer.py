@@ -199,10 +199,15 @@ class Trainer:
         from ..ops.attention import _on_tpu
         if not _on_tpu():
             return None
-        has_conv = any(l.cfg.type == "kConvolution"
-                       for l in self.train_net.layers.values())
+        # Only AlexNet-scale conv stacks: the raised budget hung the
+        # LeNet compile outright (>9min vs 55s; the compiler's conv
+        # window search appears to explode with the bigger fusion
+        # space on small-channel convs), and small nets don't need it.
+        widths = [l.num_filters for l in self.train_net.layers.values()
+                  if l.cfg.type == "kConvolution"]
+        big_conv = bool(widths) and max(widths) >= 96
         return (dict(self.TPU_CONV_COMPILER_OPTIONS) or None) \
-            if has_conv else None
+            if big_conv else None
 
     def _build_steps(self, donate: bool) -> None:
         net, updater, mults = self.train_net, self.updater, self.multipliers
