@@ -191,8 +191,9 @@ class Trainer:
     #: path is jit(compiler_options=...), which the axon compile helper
     #: forwards per-compile).  The transformer family REGRESSES under
     #: the raised budget (0.201 -> 0.179 MFU — it shrinks the VMEM left
-    #: to the Pallas flash kernels), so the option applies only to nets
-    #: with convolution layers.
+    #: to the Pallas flash kernels), and LeNet-scale convs HANG the
+    #: compile under it, so the option applies only to nets whose
+    #: widest convolution has >= 96 filters (see _compiler_options).
     TPU_CONV_COMPILER_OPTIONS = {"xla_tpu_scoped_vmem_limit_kib": "98304"}
 
     def _compiler_options(self):

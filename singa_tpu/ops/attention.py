@@ -55,273 +55,6 @@ def attention_reference(q, k, v, causal: bool = True,
 # Pallas flash kernel
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
-                  acc_ref, *, causal: bool, scale: float, block_q: int,
-                  block_k: int):
-    iq = pl.program_id(1)
-    ik = pl.program_id(2)
-    nk = pl.num_programs(2)
-
-    @pl.when(ik == 0)
-    def _init():
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-
-    # causal: skip fully-masked kv blocks (block start beyond q block end)
-    def compute():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if causal:
-            s = jnp.where(_causal_mask_block(iq, ik, block_q, block_k),
-                          s, NEG_INF)
-        m_prev = m_ref[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_ref[:] = m_new
-
-    if causal:
-        @pl.when(ik * block_k <= (iq + 1) * block_q - 1)
-        def _():
-            compute()
-    else:
-        compute()
-
-    @pl.when(ik == nk - 1)
-    def _finalize():
-        l_safe = jnp.maximum(l_ref[:], 1e-30)
-        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
-        # lse rides with a trailing singleton so the block's last-two
-        # dims are (bq, 1), which Mosaic accepts ((1, bq) is not)
-        lse_ref[0] = m_ref[:] + jnp.log(l_safe)
-
-
-_PARALLEL_SEM = ("parallel", "parallel", "arbitrary")
-
-
-def _tpu_params():
-    """Grid semantics for the flash kernels: batch·head and the outer
-    seq dim are parallel, the accumulation dim is sequential.  Telling
-    Mosaic this halves the small-model kernel time (7.7 -> 3.9 ms fwd
-    on the 12x64 S=1024 stack, measured with the 512-block sweep in
-    the commit adding this)."""
-    return pltpu.CompilerParams(dimension_semantics=_PARALLEL_SEM)
-
-
-def _fit_block(s: int, want: int) -> int:
-    """Largest block <= `want` dividing s (s is a multiple of 128, so
-    the halving loop terminates at or above 128)."""
-    c = min(want, s)
-    while s % c:
-        c //= 2
-    return c
-
-
-def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
-                   interpret: bool):
-    """Returns (out, lse); lse (B, H, S) feeds the Pallas backward."""
-    b, h, sq, d = q.shape
-    sk = k.shape[2]
-    bq, bk = _fit_block(sq, block_q), _fit_block(sk, block_k)
-    assert sq % bq == 0 and sk % bk == 0, (
-        f"seq lens ({sq},{sk}) must be multiples of blocks ({bq},{bk})")
-    scale = 1.0 / math.sqrt(d)
-    qr = q.reshape(b * h, sq, d)
-    kr = k.reshape(b * h, sk, d)
-    vr = v.reshape(b * h, sk, d)
-    grid = (b * h, sq // bq, sk // bk)
-    out, lse = pl.pallas_call(
-        functools.partial(_flash_kernel, causal=causal, scale=scale,
-                          block_q=bq, block_k=bk),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, iq, ik: (bh, iq, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, iq, ik: (bh, ik, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, iq, ik: (bh, ik, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, iq, ik: (bh, iq, 0)),
-            pl.BlockSpec((1, bq, 1), lambda bh, iq, ik: (bh, iq, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((bq, 1), jnp.float32),
-            pltpu.VMEM((bq, 1), jnp.float32),
-            pltpu.VMEM((bq, d), jnp.float32),
-        ],
-        compiler_params=None if interpret else _tpu_params(),
-        interpret=interpret,
-    )(qr, kr, vr)
-    return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)
-
-
-
-def _causal_mask_block(iq, ik, block_q, block_k):
-    qpos = iq * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
-    kpos = ik * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
-    return qpos >= kpos
-
-
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
-                         dq_ref, acc_ref, *, causal, scale, block_q,
-                         block_k):
-    """dq = τ·Σ_k ds·k, accumulated over kv blocks (innermost grid dim)."""
-    iq = pl.program_id(1)
-    ik = pl.program_id(2)
-    nk = pl.num_programs(2)
-
-    @pl.when(ik == 0)
-    def _init():
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-
-    def compute():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if causal:
-            s = jnp.where(_causal_mask_block(iq, ik, block_q, block_k),
-                          s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0])        # lse block (bq, 1) broadcasts
-        do = do_ref[0].astype(jnp.float32)
-        dp = jax.lax.dot_general(
-            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dp - dl_ref[0])
-        acc_ref[:] = acc_ref[:] + jax.lax.dot_general(
-            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-
-    if causal:
-        @pl.when(ik * block_k <= (iq + 1) * block_q - 1)
-        def _():
-            compute()
-    else:
-        compute()
-
-    @pl.when(ik == nk - 1)
-    def _done():
-        dq_ref[0] = (acc_ref[:] * scale).astype(dq_ref.dtype)
-
-
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
-                          dk_ref, dv_ref, dk_acc, dv_acc, *, causal,
-                          scale, block_q, block_k):
-    """dv = Σ_q pᵀ·do and dk = τ·Σ_q dsᵀ·q, accumulated over q blocks
-    (innermost grid dim)."""
-    ik = pl.program_id(1)
-    iq = pl.program_id(2)
-    nq = pl.num_programs(2)
-
-    @pl.when(iq == 0)
-    def _init():
-        dk_acc[:] = jnp.zeros_like(dk_acc)
-        dv_acc[:] = jnp.zeros_like(dv_acc)
-
-    def compute():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if causal:
-            s = jnp.where(_causal_mask_block(iq, ik, block_q, block_k),
-                          s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0])        # lse block (bq, 1) broadcasts
-        do = do_ref[0].astype(jnp.float32)
-        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
-            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(
-            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dp - dl_ref[0])
-        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
-            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-
-    if causal:
-        @pl.when(ik * block_k <= (iq + 1) * block_q - 1)
-        def _():
-            compute()
-    else:
-        compute()
-
-    @pl.when(iq == nq - 1)
-    def _done():
-        dk_ref[0] = (dk_acc[:] * scale).astype(dk_ref.dtype)
-        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
-
-
-def _flash_backward(q, k, v, out, lse, do, causal, block_q, block_k,
-                    interpret):
-    """FlashAttention backward via the two Pallas kernels above.
-
-    delta = rowsum(do·out) (the D term) is a cheap fused jnp op; the
-    kernels then recompute p per tile from (q, k, lse) — the S×S score
-    matrix never exists in HBM, matching the forward's memory profile,
-    and every matmul (p, dp, ds·k, dsᵀ·q, pᵀ·do) rides the MXU.
-    """
-    b, h, sq, d = q.shape
-    sk = k.shape[2]
-    bq, bk = _fit_block(sq, block_q), _fit_block(sk, block_k)
-    scale = 1.0 / math.sqrt(d)
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)                              # (B, H, Sq)
-    qr = q.reshape(b * h, sq, d)
-    kr = k.reshape(b * h, sk, d)
-    vr = v.reshape(b * h, sk, d)
-    dor = do.reshape(b * h, sq, d).astype(q.dtype)
-    lser = lse.reshape(b * h, sq, 1)
-    dr = delta.reshape(b * h, sq, 1)
-
-    q_spec = pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0))
-    k_spec = pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0))
-    r_spec = pl.BlockSpec((1, bq, 1), lambda bh, i, j: (bh, i, 0))
-    dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, causal=causal,
-                          scale=scale, block_q=bq, block_k=bk),
-        grid=(b * h, sq // bq, sk // bk),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
-        out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=None if interpret else _tpu_params(),
-        interpret=interpret,
-    )(qr, kr, vr, dor, lser, dr)
-
-    # dkv grid: kv block outer, q block inner (accumulation dim)
-    q_spec2 = pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0))
-    k_spec2 = pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0))
-    r_spec2 = pl.BlockSpec((1, bq, 1), lambda bh, j, i: (bh, i, 0))
-    dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, causal=causal,
-                          scale=scale, block_q=bq, block_k=bk),
-        grid=(b * h, sk // bk, sq // bq),
-        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2],
-        out_specs=[k_spec2, k_spec2],
-        out_shape=[jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
-                   jax.ShapeDtypeStruct((b * h, sk, d), v.dtype)],
-        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
-                        pltpu.VMEM((bk, d), jnp.float32)],
-        compiler_params=None if interpret else _tpu_params(),
-        interpret=interpret,
-    )(qr, kr, vr, dor, lser, dr)
-    return (dq.reshape(q.shape), dk.reshape(k.shape),
-            dv.reshape(v.shape))
-
-
 def _on_tpu() -> bool:
     """True when the default device is TPU hardware.  Checks device_kind
     as well as platform because tunneled TPU backends (e.g. the `axon`
@@ -333,6 +66,50 @@ def _on_tpu() -> bool:
         return False
     return ("tpu" in getattr(dev, "platform", "").lower()
             or "TPU" in getattr(dev, "device_kind", ""))
+
+
+def _fit_block(s: int, want: int) -> int:
+    """Largest block <= `want` dividing s (s is a multiple of 128, so
+    the halving loop terminates at or above 128)."""
+    c = min(want, s)
+    while s % c:
+        c //= 2
+    return c
+
+
+def _causal_mask_block(iq, ik, block_q, block_k):
+    qpos = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    kpos = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return qpos >= kpos
+
+
+def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
+                   interpret: bool):
+    """Strided (B, H, S, D) flash forward.  (B·H, S, D) IS the packed
+    layout with one head per row, so this is the packed kernel with
+    num_heads=1 — one online-softmax implementation serves both entry
+    points.  Returns (out, lse (B, H, S, 1))."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    out, lse = _packed_forward(
+        q.reshape(b * h, sq, d), k.reshape(b * h, sk, d),
+        v.reshape(b * h, sk, d), 1, causal, block_q, block_k, interpret)
+    return out.reshape(b, h, sq, d), lse.reshape(b, h, sq, 1)
+
+
+def _flash_backward(q, k, v, out, lse, do, causal, block_q, block_k,
+                    interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    dq, dk, dv = _packed_backward(
+        q.reshape(b * h, sq, d), k.reshape(b * h, sk, d),
+        v.reshape(b * h, sk, d), out.reshape(b * h, sq, d),
+        lse.reshape(b * h, sq, 1), do.reshape(b * h, sq, d),
+        1, causal, block_q, block_k, interpret)
+    return (dq.reshape(q.shape), dk.reshape(k.shape),
+            dv.reshape(v.shape))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -464,17 +241,26 @@ flash_attention.defvjp(_flash_fwd, _flash_bwd)
 # RoPE + GQA helpers
 
 
-def rope(x: jnp.ndarray, positions: jnp.ndarray,
-         theta: float = 10000.0) -> jnp.ndarray:
-    """Rotary embeddings. x: (B, H, S, D) with even D; positions: (S,)."""
-    d = x.shape[-1]
+def _rope_angles(positions: jnp.ndarray, d: int, theta: float):
+    """(cos, sin) each (S, D/2) — shared by both rope layouts."""
     freqs = theta ** (-jnp.arange(0, d // 2, dtype=jnp.float32) / (d // 2))
-    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (S, D/2)
-    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def _rotate_halves(x, cos, sin):
+    d = x.shape[-1]
     x1, x2 = x[..., : d // 2], x[..., d // 2:]
     return jnp.concatenate(
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin],
         axis=-1).astype(x.dtype)
+
+
+def rope(x: jnp.ndarray, positions: jnp.ndarray,
+         theta: float = 10000.0) -> jnp.ndarray:
+    """Rotary embeddings. x: (B, H, S, D) with even D; positions: (S,)."""
+    cos, sin = _rope_angles(positions, x.shape[-1], theta)
+    return _rotate_halves(x, cos, sin)
 
 
 def expand_kv_heads(kv: jnp.ndarray, num_heads: int) -> jnp.ndarray:
@@ -776,12 +562,7 @@ def rope_packed(x: jnp.ndarray, positions: jnp.ndarray, num_heads: int,
     through a free trailing-dim split/merge (no transposes)."""
     b, s, hd = x.shape
     d = hd // num_heads
-    freqs = theta ** (-jnp.arange(0, d // 2, dtype=jnp.float32) / (d // 2))
-    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
-    cos = jnp.cos(angles)[None, :, None, :]     # (1, S, 1, D/2)
-    sin = jnp.sin(angles)[None, :, None, :]
-    xh = x.reshape(b, s, num_heads, d)
-    x1, x2 = xh[..., : d // 2], xh[..., d // 2:]
-    out = jnp.concatenate(
-        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
-    return out.astype(x.dtype).reshape(b, s, hd)
+    cos, sin = _rope_angles(positions, d, theta)
+    out = _rotate_halves(x.reshape(b, s, num_heads, d),
+                         cos[None, :, None, :], sin[None, :, None, :])
+    return out.reshape(b, s, hd)
