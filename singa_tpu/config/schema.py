@@ -400,10 +400,21 @@ class ModelConfig:
     precision: str = "float32"        # compute dtype: float32 | bfloat16
     checkpoint_frequency: int = 0
     checkpoint_after_steps: int = 0
+    # Raised scoped-VMEM compiler budget for conv-family step programs
+    # (see Trainer._compiler_options): "auto" applies it when the net's
+    # widest conv has >= 96 filters (the raised budget HANGS LeNet-scale
+    # compiles, which is why auto exists), "on" forces it, "off"
+    # disables it.  The SINGA_TPU_SCOPED_VMEM env var (same values)
+    # overrides this field.
+    scoped_vmem: str = "auto"         # auto | on | off
 
     def __post_init__(self):
         if self.alg not in GRAD_CALC_ALGS:
             raise ConfigError(f"bad alg {self.alg!r}")
+        if self.scoped_vmem not in ("auto", "on", "off"):
+            raise ConfigError(
+                f"scoped_vmem must be auto|on|off, got "
+                f"{self.scoped_vmem!r}")
 
 
 # ---------------------------------------------------------------------------

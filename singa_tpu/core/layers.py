@@ -262,19 +262,35 @@ class RGBImageLayer(Layer):
         x = x.transpose(0, 2, 3, 1)  # → NHWC
         b, h, w, c = x.shape
         cs = self.cropsize
+        # Per-IMAGE augmentation randomness, as the reference draws it
+        # inside its per-record parse loop (layer.cc:587-616:
+        # hoff=rand()%(shape-cropsize) and do_mirror=mirror_&&rand()%2
+        # for every record).  Batch-correlated crops/flips are
+        # measurably weaker regularization.  Two deliberate deviations
+        # from the reference's literal code: (a) it re-rolls the mirror
+        # coin outside the `training` guard (layer.cc:613), mirroring
+        # at test time — here mirror is train-only; (b) at test time
+        # with a cropsize it memcpys the full record into the smaller
+        # cropped blob (layer.cc:596-602) — here eval takes the
+        # conventional center crop.
+        rng = (ctx.layer_rng()
+               if ctx.train and (self.mirror or
+                                 (cs and (h > cs or w > cs)))
+               else None)
         if cs and (h > cs or w > cs):
             if ctx.train:
-                rng = ctx.layer_rng()
-                r1, r2, r3 = jax.random.split(rng, 3)
-                oh = jax.random.randint(r1, (), 0, h - cs + 1)
-                ow = jax.random.randint(r2, (), 0, w - cs + 1)
-                x = jax.lax.dynamic_slice(x, (0, oh, ow, 0), (b, cs, cs, c))
-                if self.mirror:
-                    flip = jax.random.bernoulli(r3)
-                    x = jnp.where(flip, x[:, :, ::-1], x)
+                r1, r2, rng = jax.random.split(rng, 3)
+                oh = jax.random.randint(r1, (b,), 0, max(h - cs, 1))
+                ow = jax.random.randint(r2, (b,), 0, max(w - cs, 1))
+                x = jax.vmap(
+                    lambda img, i, j: jax.lax.dynamic_slice(
+                        img, (i, j, 0), (cs, cs, c)))(x, oh, ow)
             else:
                 oh, ow = (h - cs) // 2, (w - cs) // 2
                 x = x[:, oh:oh + cs, ow:ow + cs]
+        if self.mirror and ctx.train:
+            flip = jax.random.bernoulli(rng, shape=(b,))
+            x = jnp.where(flip[:, None, None, None], x[:, :, ::-1], x)
         x = x * self.scale
         if ctx.compute_dtype is not None:
             x = x.astype(ctx.compute_dtype)

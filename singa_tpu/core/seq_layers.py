@@ -26,7 +26,8 @@ from ..ops.attention import (attention_reference, expand_kv_heads,
                              flash_attention, rope)
 from .layers import Layer, LayerError, register_layer
 
-# attention layers that already warned about the dense-fallback path
+# (layer name, seq_len, head_dim) triples that already warned about
+# the dense-fallback path
 _flash_fallback_warned: set = set()
 
 
@@ -229,8 +230,12 @@ class AttentionLayer(Layer):
         elif s % 128 == 0 and self.head_dim % 8 == 0:
             out = flash_attention(q, k, v, self.causal)
         else:
-            if self.cfg.name not in _flash_fallback_warned:
-                _flash_fallback_warned.add(self.cfg.name)
+            # once-keyed on (name, shape): a second model reusing a
+            # layer name at a different geometry still warns
+            if (self.cfg.name, s, self.head_dim) \
+                    not in _flash_fallback_warned:
+                _flash_fallback_warned.add(
+                    (self.cfg.name, s, self.head_dim))
                 import sys
                 print(f"warning: attention layer {self.cfg.name!r} "
                       f"(seq_len={s}, head_dim={self.head_dim}) falls "

@@ -40,38 +40,41 @@ def resolve_data_source(model_cfg, batchsize: int, seed: int = 0,
             return (mk(stream_seed if stream_seed is not None
                        else seed), (lambda: mk(seed + 7919)))
 
-    train_skip = 0
-    for layer in layers:
-        if layer.type in ("kShardData", "kLMDBData") and layer.data_param:
-            if layer.type == "kLMDBData" and not force_synthetic:
-                p = layer.data_param.path
-                if p and (os.path.isfile(p)
-                          or os.path.isfile(os.path.join(p, "data.mdb"))):
-                    # refuse rather than silently substitute another
-                    # source for real LMDB data (layer.cc:237-328 walks
-                    # a caffe LMDB cursor; no LMDB reader is available
-                    # in this environment — convert with
-                    # tools/loader.py into a shard folder instead)
-                    raise NotImplementedError(
-                        f"kLMDBData layer {layer.name!r} points at an "
-                        f"existing LMDB environment {p!r}, which this "
-                        f"build cannot read; convert it to a shard "
-                        f"folder with singa_tpu.tools.loader")
-                import sys as _sys
-                print(f"warning: kLMDBData layer {layer.name!r} path "
-                      f"{p!r} not found; using the synthetic source",
-                      file=_sys.stderr)
-            if "kTrain" not in layer.exclude:
-                train_path, train_name = layer.data_param.path, layer.name
-                train_skip = layer.data_param.random_skip
-            else:
-                test_path, test_name = layer.data_param.path, layer.name
-
     def shard_ok(p):
         return (not force_synthetic and p and
                 os.path.isfile(os.path.join(p, "shard.dat")))
 
-    if shard_ok(train_path):
+    def lmdb_ok(p):
+        return (not force_synthetic and p and
+                (os.path.isfile(p)
+                 or os.path.isfile(os.path.join(p, "data.mdb"))))
+
+    train_skip = 0
+    train_lmdb = test_lmdb = False
+    for layer in layers:
+        if layer.type in ("kShardData", "kLMDBData") and layer.data_param:
+            is_lmdb = layer.type == "kLMDBData"
+            if is_lmdb and not force_synthetic \
+                    and not lmdb_ok(layer.data_param.path):
+                import sys as _sys
+                print(f"warning: kLMDBData layer {layer.name!r} "
+                      f"path {layer.data_param.path!r} not found; "
+                      f"using the synthetic source", file=_sys.stderr)
+            if "kTrain" not in layer.exclude:
+                train_path, train_name = layer.data_param.path, layer.name
+                train_skip = layer.data_param.random_skip
+                train_lmdb = is_lmdb
+            else:
+                test_path, test_name = layer.data_param.path, layer.name
+                test_lmdb = is_lmdb
+
+    from .pipeline import lmdb_batches
+    if train_lmdb and lmdb_ok(train_path):
+        train_iter = prefetch(lmdb_batches(
+            train_path, batchsize, train_name,
+            seed=(stream_seed if stream_seed is not None else seed),
+            random_skip=train_skip))
+    elif shard_ok(train_path):
         # stream decorrelation on real shards rides DataProto.random_skip
         # (layer.cc:646-673): each stream_seed draws a different initial
         # skip.  File order is otherwise fixed — warn when a caller asks
@@ -95,7 +98,10 @@ def resolve_data_source(model_cfg, batchsize: int, seed: int = 0,
             batchsize, data_layer=train_name, seed=seed,
             stream_seed=(stream_seed if stream_seed is not None
                          else seed + 101))
-    if shard_ok(test_path):
+    if test_lmdb and lmdb_ok(test_path):
+        test_factory = lambda: lmdb_batches(
+            test_path, batchsize, test_name, loop=False)
+    elif shard_ok(test_path):
         test_factory = lambda: shard_batches(
             test_path, batchsize, test_name, loop=False)
     else:

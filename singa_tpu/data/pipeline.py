@@ -38,6 +38,48 @@ def _decode_batch(vals: List[bytes], data_layer: str) -> Dict:
                          "label": np.asarray(labels, np.int32)}}
 
 
+def lmdb_batches(path: str, batchsize: int, data_layer: str = "data",
+                 loop: bool = True, random_skip: int = 0,
+                 seed: int = 0) -> Iterator[Dict]:
+    """Batches straight from an LMDB environment of caffe Datum values
+    (kLMDBData semantics, layer.cc:237-328): B-tree key order, Datum →
+    Record conversion, same random_skip contract as shard_batches.
+    For production throughput convert once with
+    `tools/loader.py convert-lmdb` (shards get the native batch
+    decoder); this path exists so reference configs pointing at an
+    LMDB env train unchanged."""
+    from .lmdb_reader import iter_lmdb
+    from .records import Datum, record_from_datum
+
+    rng = np.random.default_rng(seed)
+    skip = rng.integers(0, random_skip + 1) if random_skip else 0
+    while True:
+        vals: List[bytes] = []
+        usable = 0
+        for _, raw in iter_lmdb(path):
+            if skip > 0:
+                skip -= 1
+                continue
+            rec = record_from_datum(Datum.decode(raw))
+            if rec.image is None or not (rec.image.pixel
+                                         or rec.image.data):
+                continue
+            usable += 1
+            vals.append(rec.encode())
+            if len(vals) == batchsize:
+                yield _decode_batch(vals, data_layer)
+                vals = []
+        if loop and not usable:
+            # never spin hot re-reading an empty env forever
+            raise ValueError(
+                f"LMDB environment {path!r} contains no usable image "
+                f"records (after random_skip)")
+        if not loop:
+            if vals:
+                yield _decode_batch(vals, data_layer)
+            return
+
+
 def shard_batches(folder: str, batchsize: int, data_layer: str = "data",
                   loop: bool = True, random_skip: int = 0,
                   seed: int = 0) -> Iterator[Dict]:

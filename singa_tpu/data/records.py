@@ -176,6 +176,22 @@ def record_has_image(buf: bytes) -> bool:
     return False
 
 
+def record_from_datum(d: "Datum") -> "Record":
+    """caffe Datum → Record, the conversion the reference's LMDB parse
+    loop performs implicitly (layer.cc:285-316: Datum fields copied
+    into the blob the same way Record fields are)."""
+    if d.encoded:
+        raise ValueError(
+            "encoded (JPEG/PNG) Datum values are not supported — "
+            "re-export the LMDB with convert_imageset's raw mode, or "
+            "decode to raw pixels before conversion (no image codec "
+            "exists in this environment)")
+    img = SingleLabelImageRecord(
+        shape=[d.channels, d.height, d.width], label=d.label,
+        pixel=d.data, data=list(d.float_data) if not d.data else [])
+    return Record(image=img)
+
+
 @dataclass
 class Datum:
     """caffe's LMDB record (model.proto:288-299)."""

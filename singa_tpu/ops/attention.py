@@ -69,11 +69,20 @@ def _on_tpu() -> bool:
 
 
 def _fit_block(s: int, want: int) -> int:
-    """Largest block <= `want` dividing s (s is a multiple of 128, so
-    the halving loop terminates at or above 128)."""
+    """Largest block <= `want` dividing s.  The kernels need blocks of
+    at least a (8, 128) TPU tile row count; a seq len that only admits
+    smaller blocks (odd / non-multiple-of-128 S) would otherwise
+    surface as an obscure Mosaic tiling error, so fail loudly here and
+    point callers at the dense fallback."""
     c = min(want, s)
     while s % c:
         c //= 2
+    if c % 8:
+        raise ValueError(
+            f"flash attention needs a block size that is a multiple of "
+            f"8 dividing seq_len={s} (got best fit {c}); pad the "
+            f"sequence to a multiple of 128 or use "
+            f"attention_reference (the dense fallback)")
     return c
 
 

@@ -105,19 +105,26 @@ def eligible(x, layout: str = "NHWC") -> bool:
             and x.dtype in (jnp.float32, jnp.bfloat16))
 
 
-def _call(kernel, args, band, out_dtype, hw, c, n, n_blk, interpret):
+def _call(kernel, args, band, out_dtype, hw, c, n, n_blk, interpret,
+          hw_blk=None, parallel=True):
     if n % n_blk:
         n_blk = 128   # eligible() guarantees n % 128 == 0
-    hw_blk = _hw_block(hw, c)
+    if hw_blk is None:
+        hw_blk = _hw_block(hw, c)
     grid = (hw // hw_blk, n // n_blk)
     spec = pl.BlockSpec((hw_blk, c, n_blk), lambda i, j: (i, 0, j))
     bspec = pl.BlockSpec((c, c), lambda i, j: (0, 0))
+    from jax.experimental.pallas import tpu as pltpu
+    params = (pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel")) if parallel
+        and not interpret else None)
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[spec] * len(args) + [bspec],
         out_specs=spec,
         out_shape=jax.ShapeDtypeStruct((hw, c, n), out_dtype),
+        compiler_params=params,
         interpret=interpret,
     )(*args, band)
 
@@ -134,7 +141,8 @@ def _from_lanes(y, n, h, w, c):
 
 
 def lrn_fwd_pallas(x, local_size: int, alpha: float, beta: float,
-                   knorm: float, relu: bool, interpret: bool = False):
+                   knorm: float, relu: bool, interpret: bool = False,
+                   n_blk: int = 256, hw_blk=None, parallel: bool = True):
     if not eligible(x):
         raise ValueError(f"lrn_pallas needs N%128==0 and C%8==0; got "
                          f"{x.shape} {x.dtype}")
@@ -144,12 +152,13 @@ def lrn_fwd_pallas(x, local_size: int, alpha: float, beta: float,
         _fwd_kernel, coef=alpha / local_size, knorm=knorm, beta=beta,
         relu=relu)
     y = _call(kern, [_to_lanes(x)], band, x.dtype, h * w, c, n,
-              min(n, 256), interpret)
+              min(n, n_blk), interpret, hw_blk, parallel)
     return _from_lanes(y, n, h, w, c)
 
 
 def lrn_bwd_pallas(x, g, local_size: int, alpha: float, beta: float,
-                   knorm: float, relu: bool, interpret: bool = False):
+                   knorm: float, relu: bool, interpret: bool = False,
+                   n_blk: int = 256, hw_blk=None, parallel: bool = True):
     if not eligible(x):
         raise ValueError(f"lrn_pallas needs N%128==0 and C%8==0; got "
                          f"{x.shape} {x.dtype}")
@@ -159,5 +168,5 @@ def lrn_bwd_pallas(x, g, local_size: int, alpha: float, beta: float,
         _bwd_kernel, coef=alpha / local_size, knorm=knorm, beta=beta,
         relu=relu)
     dx = _call(kern, [_to_lanes(x), _to_lanes(g)], band, x.dtype,
-               h * w, c, n, min(n, 256), interpret)
+               h * w, c, n, min(n, n_blk), interpret, hw_blk, parallel)
     return _from_lanes(dx, n, h, w, c)

@@ -10,6 +10,9 @@ Modes (same surface):
           data_loader.cc:43-94)
   mean:   compute the per-pixel float mean of a shard and write it as a
           single Record (the reference's mean.binaryproto role)
+  convert-lmdb: walk a caffe LMDB environment of Datum values
+          (layer.cc:237-328's data source) and rewrite it as a Shard
+          of Record protos, so the native batch decoder applies
 
 Usage:
   python -m singa_tpu.tools.loader create mnist  <images.idx> <labels.idx> <out_folder>
@@ -17,6 +20,7 @@ Usage:
   python -m singa_tpu.tools.loader create imagefolder <img_dir> <list_file> <out_folder> [size]
   python -m singa_tpu.tools.loader split <in_folder> <out_prefix> <n>
   python -m singa_tpu.tools.loader mean <shard_folder> <out_file>
+  python -m singa_tpu.tools.loader convert-lmdb <lmdb_env> <out_folder>
 """
 
 from __future__ import annotations
@@ -124,6 +128,22 @@ def create_shard(source: Iterator[Tuple[np.ndarray, int]], out_folder: str,
     return n
 
 
+def convert_lmdb(lmdb_env: str, out_folder: str) -> int:
+    """caffe LMDB → Shard: walk the env in key order, convert each
+    Datum to a Record (same keys), and insert into a fresh shard."""
+    from ..data.lmdb_reader import iter_lmdb
+    from ..data.records import Datum, record_from_datum
+
+    os.makedirs(out_folder, exist_ok=True)
+    n = 0
+    with Shard(out_folder, Shard.KCREATE) as sh:
+        for key, raw in iter_lmdb(lmdb_env):
+            rec = record_from_datum(Datum.decode(raw))
+            if sh.insert(key, rec.encode()):
+                n += 1
+    return n
+
+
 def split_shard(in_folder: str, out_prefix: str, n: int) -> List[int]:
     """Round-robin split into n sub-shards (SplitN semantics)."""
     outs = []
@@ -161,6 +181,10 @@ def main(argv=None) -> int:
         size = int(argv[5]) if len(argv) > 5 else 256
         n = create_shard(read_image_folder(img_dir, list_file, size), out)
         print(f"wrote {n} records to {out}")
+    elif cmd == "convert-lmdb":
+        env, out = argv[1], argv[2]
+        n = convert_lmdb(env, out)
+        print(f"converted {n} LMDB records to {out}")
     elif cmd == "split":
         in_folder, out_prefix, n = argv[1], argv[2], int(argv[3])
         counts = split_shard(in_folder, out_prefix, n)

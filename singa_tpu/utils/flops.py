@@ -85,7 +85,12 @@ def _attention_flops(layer) -> int:
     proj = 2 * b * s * e * (hd + 2 * kvd + hd)        # wq wk wv wo
     scores = 4 * b * layer.heads * s * s * layer.head_dim   # qk + pv
     if layer.causal:
-        scores //= 2       # flash kernels skip fully-masked blocks
+        # standard causal-half accounting convention (only ~half the
+        # score matrix is live).  NOTE this is a convention, not a
+        # kernel fact: the dense fallback computes the full S^2 and
+        # flash diagonal blocks are full tiles, so MFU comparability
+        # across paths is approximate.
+        scores //= 2
     return proj + scores
 
 

@@ -1,0 +1,162 @@
+"""Test-only LMDB environment writer.
+
+No liblmdb exists in this environment, so tests synthesize a real
+on-disk LMDB 0.9 environment from the format spec (see
+singa_tpu/data/lmdb_reader.py for the layout facts): meta pages 0/1,
+leaf/branch B-tree pages, and overflow chains for values that don't
+fit in a page.  The writer is deliberately a separate from-spec
+encoder, not the reader inverted, so round-trip tests exercise the
+format contract rather than one module's private conventions.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Dict, List, Sequence, Tuple
+
+P_BRANCH, P_LEAF, P_OVERFLOW, P_META = 0x01, 0x02, 0x04, 0x08
+F_BIGDATA = 0x01
+INVALID = 0xFFFFFFFFFFFFFFFF
+
+
+def _even(n: int) -> int:
+    return n + (n & 1)
+
+
+def _page_header(pgno: int, flags: int, lower: int, upper: int) -> bytes:
+    return struct.pack("<QHHHH", pgno, 0, flags, lower, upper)
+
+
+def _overflow_header(pgno: int, npages: int) -> bytes:
+    return struct.pack("<QHHI", pgno, 0, P_OVERFLOW, npages)
+
+
+def _db(depth, branch, leaf, overflow, entries, root) -> bytes:
+    return struct.pack("<IHHQQQQQ", 0, 0, depth, branch, leaf, overflow,
+                       entries, root)
+
+
+def _meta_page(ps: int, pgno: int, txnid: int, db: bytes,
+               last_pg: int) -> bytes:
+    body = struct.pack("<IIQQ", 0xBEEFC0DE, 1, 0, 1048576)
+    body += _db(0, 0, 0, 0, 0, INVALID)          # free DB
+    body += db                                   # main DB
+    body += struct.pack("<QQ", last_pg, txnid)
+    page = _page_header(pgno, P_META, 0, 0) + body
+    return page.ljust(ps, b"\x00")
+
+
+def write_lmdb(path: str, items: Sequence[Tuple[bytes, bytes]],
+               page_size: int = 4096) -> str:
+    """Write `items` as <path>/data.mdb; returns the file path."""
+    os.makedirs(path, exist_ok=True)
+    items = sorted(items)
+    ps = page_size
+    max_inline = ps // 2 - 32        # bigger values go to overflow
+
+    pages: Dict[int, bytes] = {}
+    next_pg = 2                      # 0/1 are the meta pages
+    n_overflow = 0
+
+    def alloc() -> int:
+        nonlocal next_pg
+        pg = next_pg
+        next_pg += 1
+        return pg
+
+    # ---- build leaves ----------------------------------------------------
+    leaves: List[Tuple[int, bytes, List[Tuple[bytes, bytes, int]]]] = []
+    pending: List[Tuple[bytes, bytes, int]] = []   # (key, val, ovf_pgno)
+
+    def node_size(key: bytes, val: bytes, ovf: int) -> int:
+        return _even(8 + len(key) + (8 if ovf else len(val)))
+
+    def fits(nodes) -> bool:
+        lower = 16 + 2 * len(nodes)
+        used = sum(node_size(*n) for n in nodes)
+        return lower + used <= ps
+
+    def flush_leaf():
+        nonlocal pending
+        if pending:
+            leaves.append((alloc(), pending[0][0], pending))
+            pending = []
+
+    for key, val in items:
+        ovf = 0
+        if 8 + len(key) + len(val) > max_inline:
+            # overflow chain for the value
+            npages = (16 + len(val) + ps - 1) // ps
+            ovf = alloc()
+            raw = _overflow_header(ovf, npages) + val
+            for i in range(npages):
+                pg = ovf if i == 0 else alloc()
+                pages[pg] = raw[i * ps:(i + 1) * ps].ljust(ps, b"\x00")
+            n_overflow += npages
+        if not fits(pending + [(key, val, ovf)]):
+            flush_leaf()
+        pending.append((key, val, ovf))
+    flush_leaf()
+
+    for pgno, _, nodes in leaves:
+        ptrs: List[int] = []
+        upper = ps
+        blob = bytearray(ps)
+        for key, val, ovf in nodes:
+            sz = node_size(key, val, ovf)
+            upper -= sz
+            ptrs.append(upper)
+            if ovf:
+                node = struct.pack("<HHHH", len(val) & 0xFFFF,
+                                   len(val) >> 16, F_BIGDATA, len(key))
+                node += key + struct.pack("<Q", ovf)
+            else:
+                node = struct.pack("<HHHH", len(val) & 0xFFFF,
+                                   len(val) >> 16, 0, len(key))
+                node += key + val
+            blob[upper:upper + len(node)] = node
+        lower = 16 + 2 * len(ptrs)
+        blob[:16] = _page_header(pgno, P_LEAF, lower, upper)
+        blob[16:lower] = struct.pack(f"<{len(ptrs)}H", *ptrs)
+        pages[pgno] = bytes(blob)
+
+    # ---- root ------------------------------------------------------------
+    n_branch = 0
+    if not leaves:
+        root, depth = INVALID, 0
+    elif len(leaves) == 1:
+        root, depth = leaves[0][0], 1
+    else:
+        root, depth, n_branch = alloc(), 2, 1
+        ptrs, upper = [], ps
+        blob = bytearray(ps)
+        for i, (pgno, first_key, _) in enumerate(leaves):
+            key = b"" if i == 0 else first_key
+            sz = _even(8 + len(key))
+            upper -= sz
+            ptrs.append(upper)
+            node = struct.pack("<HHHH", pgno & 0xFFFF,
+                               (pgno >> 16) & 0xFFFF, pgno >> 32,
+                               len(key)) + key
+            blob[upper:upper + len(node)] = node
+        lower = 16 + 2 * len(ptrs)
+        blob[:16] = _page_header(root, P_BRANCH, lower, upper)
+        blob[16:lower] = struct.pack(f"<{len(ptrs)}H", *ptrs)
+        pages[root] = bytes(blob)
+        if lower > upper:
+            raise ValueError("fixture writer: too many leaves for a "
+                             "single branch page")
+
+    # ---- metas + assembly ------------------------------------------------
+    last_pg = max(pages) if pages else 1
+    db = _db(depth, n_branch, len(leaves), n_overflow, len(items), root)
+    out = bytearray()
+    out += _meta_page(ps, 0, 0, _db(0, 0, 0, 0, 0, INVALID), 1)
+    out += _meta_page(ps, 1, 1, db, last_pg)
+    for pg in range(2, last_pg + 1):
+        out += pages.get(pg, b"\x00" * ps)
+    fp = os.path.join(path, "data.mdb")
+    with open(fp, "wb") as f:
+        f.write(bytes(out))
+    return fp

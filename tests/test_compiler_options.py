@@ -1,0 +1,73 @@
+"""The scoped-VMEM compiler-option knob (VERDICT r2 item 9).
+
+Policy: ModelProto `scoped_vmem` (auto|on|off), overridden by the
+SINGA_TPU_SCOPED_VMEM env var.  `auto` applies the raised budget only
+to conv stacks whose widest conv has >= 96 filters — the documented
+workaround for the LeNet-scale compile hang.
+"""
+
+import pytest
+
+import singa_tpu.ops.attention as attention
+from singa_tpu.config.schema import ConfigError, model_config_from_dict
+from singa_tpu.core.trainer import Trainer
+from singa_tpu.models.vision import alexnet_cifar10_full, lenet_mnist
+
+ALEX_SHAPES = {"data": {"pixel": (3, 32, 32), "label": ()}}
+LENET_SHAPES = {"data": {"pixel": (28, 28), "label": ()}}
+
+
+def _opts(cfg, shapes, monkeypatch, env=None):
+    monkeypatch.setattr(attention, "_on_tpu", lambda: True)
+    if env is not None:
+        monkeypatch.setenv("SINGA_TPU_SCOPED_VMEM", env)
+    else:
+        monkeypatch.delenv("SINGA_TPU_SCOPED_VMEM", raising=False)
+    t = Trainer(cfg, shapes, log_fn=lambda s: None)
+    return t._compiler_options()
+
+
+def test_auto_picks_option_for_alexnet(monkeypatch):
+    opts = _opts(alexnet_cifar10_full(batchsize=8), ALEX_SHAPES,
+                 monkeypatch)
+    assert opts == Trainer.TPU_CONV_COMPILER_OPTIONS
+
+
+def test_auto_skips_lenet(monkeypatch):
+    assert _opts(lenet_mnist(batchsize=8), LENET_SHAPES,
+                 monkeypatch) is None
+
+
+def test_field_off_disables(monkeypatch):
+    cfg = alexnet_cifar10_full(batchsize=8)
+    cfg.scoped_vmem = "off"
+    assert _opts(cfg, ALEX_SHAPES, monkeypatch) is None
+
+
+def test_field_on_forces_for_lenet(monkeypatch):
+    cfg = lenet_mnist(batchsize=8)
+    cfg.scoped_vmem = "on"
+    assert _opts(cfg, LENET_SHAPES,
+                 monkeypatch) == Trainer.TPU_CONV_COMPILER_OPTIONS
+
+
+def test_env_overrides_field(monkeypatch):
+    cfg = alexnet_cifar10_full(batchsize=8)
+    cfg.scoped_vmem = "on"
+    assert _opts(cfg, ALEX_SHAPES, monkeypatch, env="off") is None
+
+
+def test_bad_env_fails_loud(monkeypatch):
+    with pytest.raises(ValueError, match="SINGA_TPU_SCOPED_VMEM"):
+        _opts(lenet_mnist(batchsize=8), LENET_SHAPES, monkeypatch,
+              env="sometimes")
+
+
+def test_bad_field_fails_loud():
+    with pytest.raises(ConfigError, match="scoped_vmem"):
+        model_config_from_dict({"name": "x", "scoped_vmem": "maybe"})
+
+
+def test_textproto_field_parses():
+    cfg = model_config_from_dict({"name": "x", "scoped_vmem": "on"})
+    assert cfg.scoped_vmem == "on"

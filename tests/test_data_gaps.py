@@ -91,8 +91,13 @@ def test_lmdb_with_real_env_fails_loud(tmp_path):
              "param": [{"name": "weight"}, {"name": "bias"}]},
             {"name": "loss", "type": "kSoftmaxLoss",
              "srclayers": ["ip", "label"]}]}})
-    with pytest.raises(NotImplementedError, match="LMDB"):
-        resolve_data_source(cfg, 2)
+    # r2->r3: the refusal became a real read path (data/lmdb_reader.py);
+    # a corrupt env must still fail loudly, now as a format error when
+    # the first batch is pulled
+    from singa_tpu.data.lmdb_reader import LMDBFormatError
+    train_iter, _ = resolve_data_source(cfg, 2)
+    with pytest.raises(LMDBFormatError):
+        next(iter(train_iter))
 
 
 def _mnist_cfg(**mnist_kw):

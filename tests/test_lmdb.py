@@ -1,0 +1,144 @@
+"""LMDB ingestion (VERDICT r2 item 6): read a real on-disk LMDB
+environment (fixture-written from the format spec), convert it to a
+shard, and train on it.  Reference bar: layer.cc:237-328 (caffe LMDB
+cursor walk feeding Datum records)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from singa_tpu.data.lmdb_reader import (LMDBFormatError, iter_lmdb,
+                                        lmdb_entry_count)
+from singa_tpu.data.records import Datum
+
+from lmdb_fixture import write_lmdb
+
+
+def _items(n, vsize=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(b"%08d" % i, rng.bytes(vsize)) for i in range(n)]
+
+
+def test_roundtrip_single_leaf(tmp_path):
+    items = _items(8)
+    write_lmdb(str(tmp_path), items)
+    assert list(iter_lmdb(str(tmp_path))) == items
+    assert lmdb_entry_count(str(tmp_path)) == 8
+
+
+def test_roundtrip_multi_leaf_branch(tmp_path):
+    items = _items(200, vsize=100)       # forces several leaves + branch
+    write_lmdb(str(tmp_path), items)
+    assert list(iter_lmdb(str(tmp_path))) == items
+
+
+def test_roundtrip_overflow_values(tmp_path):
+    # 3KB values on 4KB pages — the caffe Datum case — plus a >1-page
+    # value to exercise multi-page overflow chains
+    items = _items(10, vsize=3000) + [(b"zzbig", os.urandom(9000))]
+    write_lmdb(str(tmp_path), items)
+    got = dict(iter_lmdb(str(tmp_path)))
+    assert got == dict(items)
+
+
+def test_key_order_is_btree_order(tmp_path):
+    items = _items(50, vsize=500)
+    write_lmdb(str(tmp_path), list(reversed(items)))
+    assert [k for k, _ in iter_lmdb(str(tmp_path))] == sorted(
+        k for k, _ in items)
+
+
+def test_empty_env(tmp_path):
+    write_lmdb(str(tmp_path), [])
+    assert list(iter_lmdb(str(tmp_path))) == []
+
+
+def test_garbage_fails_loud(tmp_path):
+    p = tmp_path / "data.mdb"
+    p.write_bytes(os.urandom(8192))
+    with pytest.raises(LMDBFormatError):
+        list(iter_lmdb(str(tmp_path)))
+
+
+def test_datum_values_decode(tmp_path):
+    rng = np.random.default_rng(1)
+    items = []
+    for i in range(6):
+        d = Datum(channels=3, height=8, width=8,
+                  data=rng.bytes(3 * 8 * 8), label=i % 3)
+        items.append((b"%08d" % i, d.encode()))
+    write_lmdb(str(tmp_path), items)
+    decoded = [Datum.decode(v) for _, v in iter_lmdb(str(tmp_path))]
+    assert [d.label for d in decoded] == [0, 1, 2, 0, 1, 2]
+    assert all(len(d.data) == 192 for d in decoded)
+
+
+def test_encoded_datum_fails_loud(tmp_path):
+    d = Datum(channels=3, height=8, width=8, data=b"\xff\xd8jpeg",
+              encoded=True)
+    write_lmdb(str(tmp_path), [(b"00000000", d.encode())])
+    from singa_tpu.data.pipeline import lmdb_batches
+    with pytest.raises(ValueError, match="encoded"):
+        next(lmdb_batches(str(tmp_path), 1))
+
+
+def test_empty_env_as_train_source_fails_loud(tmp_path):
+    write_lmdb(str(tmp_path), [])
+    from singa_tpu.data.pipeline import lmdb_batches
+    with pytest.raises(ValueError, match="no usable"):
+        next(lmdb_batches(str(tmp_path), 4, loop=True))
+
+
+def test_convert_lmdb_to_shard_and_train(tmp_path):
+    """loader convert-lmdb + kLMDBData read path: build an env of
+    Datums, convert to a shard, then resolve a kLMDBData config
+    directly against the env and take real batches from it."""
+    import jax
+
+    from singa_tpu.config.schema import model_config_from_dict
+    from singa_tpu.data import resolve_data_source
+    from singa_tpu.data.shard import Shard
+    from singa_tpu.tools import loader
+
+    rng = np.random.default_rng(2)
+    env = tmp_path / "env"
+    items = []
+    for i in range(24):
+        d = Datum(channels=3, height=8, width=8,
+                  data=rng.bytes(192), label=i % 10)
+        items.append((b"%08d" % i, d.encode()))
+    write_lmdb(str(env), items)
+
+    # conversion tool
+    out = tmp_path / "shard"
+    out.mkdir()
+    rc = loader.main(["convert-lmdb", str(env), str(out)])
+    assert rc == 0
+    shard = Shard(str(out), Shard.KREAD)
+    assert sum(1 for _ in shard) == 24
+    shard.close()
+
+    # direct kLMDBData read path
+    cfg = model_config_from_dict({
+        "name": "lmdbtest", "train_steps": 2,
+        "updater": {"type": "kSGD", "base_learning_rate": 0.1,
+                    "learning_rate_change_method": "kFixed"},
+        "neuralnet": {"layer": [
+            {"name": "data", "type": "kLMDBData",
+             "data_param": {"path": str(env), "batchsize": 8}},
+            {"name": "rgb", "type": "kRGBImage", "srclayers": "data",
+             "rgbimage_param": {"scale": 1.0}},
+            {"name": "label", "type": "kLabel", "srclayers": "data"},
+            {"name": "ip", "type": "kInnerProduct", "srclayers": "rgb",
+             "inner_product_param": {"num_output": 10},
+             "param": [{"name": "weight"}, {"name": "bias"}]},
+            {"name": "loss", "type": "kSoftmaxLoss",
+             "srclayers": ["ip", "label"]},
+        ]}})
+    train_iter, _ = resolve_data_source(cfg, 8)
+    batch = next(iter(train_iter))
+    px = np.asarray(batch["data"]["pixel"])
+    assert px.shape == (8, 3, 8, 8)
+    lbl = np.asarray(batch["data"]["label"])
+    assert list(lbl) == [i % 10 for i in range(8)]
