@@ -286,8 +286,13 @@ class Trainer:
                         f"stacked=True needs a leading {nsteps}-axis on "
                         f"every batch leaf; got shapes {bad}")
             xs = (steps, batches if stacked else None)
+            # SINGA_TPU_SCAN_UNROLL replicates the step body in the
+            # compiled loop (lax.scan unroll), trading compile time and
+            # program size for fewer loop-iteration boundaries
+            unroll = int(os.environ.get("SINGA_TPU_SCAN_UNROLL", "1"))
             (params, opt_state), metrics = jax.lax.scan(
-                body, (params, opt_state), xs, length=nsteps)
+                body, (params, opt_state), xs, length=nsteps,
+                unroll=max(1, unroll))
             return params, opt_state, metrics
 
         self.train_steps = jax.jit(train_scan, static_argnums=(5, 6),

@@ -38,7 +38,8 @@ def transformer_lm(vocab_size: int = 32000,
                    precision: str = "float32",
                    tie_embeddings: bool = True,
                    fused_head: bool = True,
-                   pipeline_stages: int = 0) -> ModelConfig:
+                   pipeline_stages: int = 0,
+                   dropout: float = 0.0) -> ModelConfig:
     """`fused_head` emits the kLMHeadLoss layer (chunked projection+xent,
     no (B,S,V) logits tensor) instead of kLMHead → kSoftmaxLoss; the two
     forms are numerically identical.
@@ -99,6 +100,14 @@ def transformer_lm(vocab_size: int = 32000,
         layers.append({"name": f"res{i}b", "type": "kResidualAdd",
                        "srclayers": [f"res{i}a", block_out], **stage_mark})
         src = f"res{i}b"
+        if dropout > 0:
+            # block-output dropout (kDropout inside the stage mark — a
+            # pipeline stage with rng-bearing layers is first-class)
+            layers.append({"name": f"drop{i}", "type": "kDropout",
+                           "srclayers": src,
+                           "dropout_param": {"dropout_ratio": dropout},
+                           **stage_mark})
+            src = f"drop{i}"
 
     layers.append({"name": "ln_f", "type": "kRMSNorm", "srclayers": src})
     if fused_head:

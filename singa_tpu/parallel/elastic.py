@@ -84,6 +84,20 @@ def sync_sample_ratio(bandwidth_mb_s: float, nservers: int, nworkers: int,
     return float(max(0.0, min(1.0, throughput / demand)))
 
 
+def sync_now(cfg: UpdaterConfig, step: int) -> bool:
+    """warmup_steps then every sync_frequency (worker.cc:44-55) — the
+    ONE cadence predicate, shared by the controller, the round-robin
+    simulation, and the distributed runtime."""
+    return (step >= cfg.warmup_steps
+            and cfg.sync_frequency > 0
+            and (step - cfg.warmup_steps) % cfg.sync_frequency == 0)
+
+
+def easgd_alpha(cfg: UpdaterConfig, ngroups: int) -> float:
+    """alpha = moving_rate / ngroups (param_manager.cc:15)."""
+    return cfg.moving_rate / max(ngroups, 1) if cfg.moving_rate else 0.0
+
+
 def async_active(ucfg: UpdaterConfig | None) -> bool:
     """True when UpdaterProto's consistency knobs request the async
     tier: RandomSync explicitly, or Elastic with a nonzero moving_rate
@@ -104,8 +118,7 @@ class ElasticController:
 
     def __init__(self, cfg: UpdaterConfig, ngroups: int = 1):
         self.cfg = cfg
-        self.alpha = (cfg.moving_rate / max(ngroups, 1)
-                      if cfg.moving_rate else 0.0)
+        self.alpha = easgd_alpha(cfg, ngroups)
         self.mode = cfg.param_type           # "Elastic" | "RandomSync"
         self.center = None
         self.snapshot = None
@@ -117,11 +130,7 @@ class ElasticController:
             self.snapshot = jax.tree_util.tree_map(jnp.copy, params)
 
     def sync_now(self, step: int) -> bool:
-        """warmup_steps then every sync_frequency (worker.cc:44-55)."""
-        return (step >= self.cfg.warmup_steps
-                and self.cfg.sync_frequency > 0
-                and (step - self.cfg.warmup_steps)
-                % self.cfg.sync_frequency == 0)
+        return sync_now(self.cfg, step)
 
     def maybe_sync(self, step: int, params, rng=None):
         """Exchange with the center at the cadence.  The center
@@ -221,3 +230,194 @@ class ReplicaSet:
     @property
     def center(self):
         return self.controllers[0].center
+
+
+class DistributedReplicaSet:
+    """The async consistency tier over REAL transport: one replica per
+    process (jax.distributed), center exchange as a global-array
+    program so the cross-process movement is XLA collectives — the
+    role the reference's ZMQ worker<->server delta push/pull played
+    (param_manager.cc:100-153, server.cc:45-214).
+
+    Trajectory-exact with the single-process `ReplicaSet` simulation on
+    the same seeds: the exchange program all-gathers the replicas
+    along a `group` mesh axis and applies the SAME sequential
+    center chain the round-robin controller applies (replica 0 first,
+    then 1, ...), with the same lazy center init (first post-warmup
+    sync seeds the center from replica 0, which skips its own exchange
+    that step — worker.cc:50-55 semantics) and the same per-replica
+    RandomSync snapshots and fold_in rng scheme.  Every process
+    computes the identical replicated center, so there is no
+    coordinator process to fail.
+    """
+
+    def __init__(self, trainer, seed: int = 0):
+        self.trainer = trainer
+        self.proc = jax.process_index()
+        self.ngroups = jax.process_count()
+        cfg = trainer.cfg.updater
+        self.cfg = cfg
+        self.alpha = easgd_alpha(cfg, self.ngroups)
+        self.mode = cfg.param_type
+        self._center_global = None            # replicated global array
+        self.snapshot = None
+        self.sample_ratio = 1.0
+        self.params, self.opt = trainer.init(seed=seed)
+        self._mesh = self._group_mesh()
+        self._exchange = None
+
+    def _group_mesh(self):
+        from jax.sharding import Mesh
+
+        import numpy as np
+        rows = [[d for d in jax.devices() if d.process_index == p]
+                for p in range(self.ngroups)]
+        width = min(len(r) for r in rows)
+        devs = np.array([r[:width] for r in rows])
+        return Mesh(devs, ("group", "local"))
+
+    def _sync_now(self, step: int) -> bool:
+        return sync_now(self.cfg, step)
+
+    # -- global-array plumbing --------------------------------------------
+    def _stack(self, tree):
+        """Local pytree -> global pytree with a leading `group` axis
+        sharded one-row-per-process (replicated over local devices)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def one(leaf):
+            leaf = jnp.asarray(leaf)[None]
+            shards = [jax.device_put(leaf, d)
+                      for d in self._mesh.devices[self.proc]]
+            return jax.make_array_from_single_device_arrays(
+                (self.ngroups,) + leaf.shape[1:],
+                NamedSharding(self._mesh, P("group")), shards)
+        return jax.tree_util.tree_map(one, tree)
+
+    def _local(self, tree):
+        """This process's row of a group-stacked global pytree."""
+        def one(leaf):
+            for s in leaf.addressable_shards:
+                return jnp.asarray(s.data)[0]
+        return jax.tree_util.tree_map(one, tree)
+
+    def _replicated(self, tree):
+        def one(leaf):
+            for s in leaf.addressable_shards:
+                return jnp.asarray(s.data)
+        return jax.tree_util.tree_map(one, tree)
+
+    def _build_exchange(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh, G = self._mesh, self.ngroups
+        grp = NamedSharding(mesh, P("group"))
+        rep = NamedSharding(mesh, P())
+        mode, alpha = self.mode, self.alpha
+
+        def unstack(tree):
+            return [jax.tree_util.tree_map(lambda x, g=g: x[g], tree)
+                    for g in range(G)]
+
+        def restack(trees):
+            return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                          *trees)
+
+        if mode == "RandomSync":
+            def exchange(stacked_r, center, stacked_s, ratio, base_rng,
+                         step, init_center):
+                """Sequential center chain, replica 0 first — the
+                exact round-robin order of ReplicaSet.run.
+                `init_center` (host bool -> two compiled variants)
+                marks the lazy-init step: center := replica 0's
+                params, replica 0 skips its own exchange, later
+                replicas exchange against the fresh center with
+                zero-delta snapshots.  `ratio` is traced so
+                sample_ratio updates (the bandwidth model) apply
+                without recompiling."""
+                rs, ss = unstack(stacked_r), unstack(stacked_s)
+                c = (rs[0] if init_center else center)
+                if init_center:
+                    ss = [jax.tree_util.tree_map(jnp.copy, r)
+                          for r in rs]
+                for g in range(1 if init_center else 0, G):
+                    rng_g = jax.random.fold_in(
+                        jax.random.fold_in(base_rng, step), g)
+                    rs[g], c, ss[g] = randomsync_update(
+                        rs[g], c, ss[g], ratio, rng_g)
+                return restack(rs), c, restack(ss)
+
+            return jax.jit(
+                exchange, static_argnums=(6,),
+                in_shardings=(grp, rep, grp, rep, rep, rep),
+                out_shardings=(grp, rep, grp))
+
+        def exchange(stacked_r, center, init_center):
+            """Elastic variant: no snapshots, no rng — the model-sized
+            snapshot round-trip would be dead weight here."""
+            rs = unstack(stacked_r)
+            c = (rs[0] if init_center else center)
+            for g in range(1 if init_center else 0, G):
+                rs[g], c = elastic_update(rs[g], c, alpha)
+            return restack(rs), c
+
+        return jax.jit(exchange, static_argnums=(2,),
+                       in_shardings=(grp, rep), out_shardings=(grp, rep))
+
+    def _sync(self, step: int, base_rng):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if self._exchange is None:
+            self._exchange = self._build_exchange()
+        rep = NamedSharding(self._mesh, P())
+        init = self._center_global is None
+        stacked_r = self._stack(self.params)
+        # replicated operands must be identical on every process
+        # (device_put to a cross-process sharding verifies this); the
+        # init-step center placeholder is zeros — the exchange program
+        # ignores it when init_center is set
+        put_rep = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda x: jax.device_put(jnp.asarray(x), rep), t)
+        center = (self._center_global if not init
+                  else put_rep(jax.tree_util.tree_map(
+                      jnp.zeros_like, self.params)))
+        if self.mode == "RandomSync":
+            snap = (self.snapshot if self.snapshot is not None
+                    else self.params)
+            out_r, c, out_s = self._exchange(
+                stacked_r, center, self._stack(snap),
+                put_rep(jnp.asarray(self.sample_ratio, jnp.float32)),
+                put_rep(base_rng),
+                put_rep(jnp.asarray(step, jnp.uint32)), init)
+            self.snapshot = self._local(out_s)
+        else:
+            out_r, c = self._exchange(stacked_r, center, init)
+        self.params = self._local(out_r)
+        self._center_global = c
+
+    def run(self, data_iter, steps: int, seed: int = 0, hooks=None):
+        """Train this process's replica for `steps` steps with center
+        exchanges at the UpdaterProto cadence.  Returns (center,
+        history) — history is THIS replica's metric list."""
+        rng = jax.random.PRNGKey(seed ^ 0xA57)
+        g = self.proc
+        history = []
+        for step in range(steps):
+            batch = next(data_iter)
+            step_rng = jax.random.fold_in(
+                jax.random.fold_in(rng, step), g)
+            self.params, self.opt, metrics = self.trainer.train_step(
+                self.params, self.opt, batch, step, step_rng)
+            if self._sync_now(step):
+                self._sync(step, rng)
+            history.append({k: float(v) for k, v in metrics.items()})
+            if hooks:
+                for h in hooks:
+                    h(step, g, history[-1])
+        return self.center, history
+
+    @property
+    def center(self):
+        """This process's copy of the (replicated) center params."""
+        return (None if self._center_global is None
+                else self._replicated(self._center_global))

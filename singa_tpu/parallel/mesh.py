@@ -82,8 +82,34 @@ def mesh_from_cluster(cluster: Optional[ClusterConfig],
             expert=cluster.expert_parallel or 1)
     group_size = cluster.nprocs_per_group * cluster.nthreads_per_procs
     ngroups = max(cluster.nworkers // max(cluster.nprocs_per_group, 1), 1)
+    # Reference topology (§2.2-2/3, cluster.h:49-60): ngroups
+    # data-parallel worker groups × group_size executors per group; the
+    # in-group executors split the BATCH under kDataPartition or the
+    # NEURON dim under kLayerPartition (neuralnet.cc:45-56).  Faithful
+    # mesh mapping:
+    #   kLayerPartition → (data=ngroups, model=group_size)
+    #   kDataPartition/kNone → one data axis over all devices (groups
+    #     and in-group executors both split the batch, so the two
+    #     levels collapse into one axis with identical numerics)
+    # Anything that cannot map exactly (device count != topology,
+    # group_size not dividing n) warns LOUDLY instead of silently
+    # reshaping.  NOTE: with an async consistency tier configured
+    # (Elastic/RandomSync), ngroups is realized by the replica runtime
+    # (parallel/elastic.py), not by this mesh.
+    def _warn(msg):
+        import sys
+        print(f"warning: mesh_from_cluster: {msg}", file=sys.stderr)
+
+    if ngroups * group_size != n:
+        _warn(f"cluster topology ngroups={ngroups} x "
+              f"group_size={group_size} != {n} devices; axis sizes "
+              f"follow the device count")
     if net_partition_type == "kLayerPartition" and group_size > 1:
-        tp = math.gcd(group_size, n)
-        return make_mesh(devices, model=tp)
+        tp = group_size if n % group_size == 0 \
+            else math.gcd(group_size, n)
+        if tp != group_size:
+            _warn(f"group_size {group_size} does not divide device "
+                  f"count {n}; model axis clipped to gcd {tp}")
+        return make_mesh(devices, data=n // tp, model=tp)
     # kDataPartition / kNone: all devices data-parallel
     return make_mesh(devices)

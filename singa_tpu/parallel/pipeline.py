@@ -35,10 +35,11 @@ def stack_stage_params(per_stage_params: Sequence[Any]) -> Any:
         lambda *leaves: jnp.stack(leaves), *per_stage_params)
 
 
-def pipeline_apply(mesh: Mesh, stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+def pipeline_apply(mesh: Mesh, stage_fn: Callable[..., jnp.ndarray],
                    stage_params: Any, x: jnp.ndarray,
                    axis: str = "pipe",
-                   batch_axis: str | None = None) -> jnp.ndarray:
+                   batch_axis: str | None = None,
+                   rng: jax.Array | None = None) -> jnp.ndarray:
     """Run microbatches through the pipeline.
 
     stage_params: pytree with leaves (n_stages, ...) — sharded over
@@ -47,6 +48,11 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarra
     `batch_axis` set (e.g. "data"), the micro_batch dim (dim 1) shards
     over that axis so dp groups pipeline DIFFERENT slices of the batch
     instead of replicating the work.
+    With `rng` set, stage_fn is called as stage_fn(params, mb, key)
+    where key = fold_in(fold_in(rng, stage), microbatch) — every
+    (stage, microbatch) cell draws independent randomness, so
+    rng-bearing layers (dropout) work inside stages; without it the
+    two-arg form is called.
     Returns (n_micro, micro_batch, ...) outputs of the final stage,
     sharded the same way.
     """
@@ -54,7 +60,12 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarra
     x_spec = P(None, batch_axis) if batch_axis else P()
     if nstages == 1:
         params0 = jax.tree_util.tree_map(lambda p: p[0], stage_params)
-        return jax.vmap(lambda mb: stage_fn(params0, mb))(x)
+        if rng is None:
+            return jax.vmap(lambda mb: stage_fn(params0, mb))(x)
+        keys = jax.vmap(
+            lambda m: jax.random.fold_in(jax.random.fold_in(rng, 0), m)
+        )(jnp.arange(x.shape[0]))
+        return jax.vmap(lambda mb, k: stage_fn(params0, mb, k))(x, keys)
 
     n_micro = x.shape[0]
     if n_micro < nstages:
@@ -68,13 +79,22 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarra
         stage = jax.lax.axis_index(axis)
         total = n_micro + nstages - 1
         fwd_perm = [(i, i + 1) for i in range(nstages - 1)]
+        stage_rng = (None if rng is None
+                     else jax.random.fold_in(rng, stage))
 
         def tick(carry, t):
             state, outputs = carry
+            # this stage processes microbatch m = t - stage at tick t
+            # (clipped during fill/drain, where the result is discarded)
+            m_idx = jnp.clip(t - stage, 0, n_micro - 1)
             x_t = jax.lax.dynamic_index_in_dim(
                 xm, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
             inp = jnp.where(stage == 0, x_t.astype(state.dtype), state)
-            out = stage_fn(params, inp)
+            if stage_rng is None:
+                out = stage_fn(params, inp)
+            else:
+                out = stage_fn(params, inp,
+                               jax.random.fold_in(stage_rng, m_idx))
             oidx = jnp.clip(t - (nstages - 1), 0, n_micro - 1)
             updated = jax.lax.dynamic_update_index_in_dim(
                 outputs, out, oidx, 0)

@@ -30,8 +30,11 @@ GPipe with per-stage rematerialization, bounding activation memory at
 O(n_micro) boundary tensors instead of O(n_micro · per-stage
 activations).
 
-Layers inside stages must be rng-free (transformer blocks are); a
-stage layer that calls ctx.layer_rng() fails loudly at trace time.
+Rng-bearing layers inside stages (dropout) are supported: the schedule
+folds an independent key per (stage, microbatch) cell from the step
+rng (pipeline_apply's `rng`), so dropout masks differ across
+microbatches exactly as they would across the equivalent unpipelined
+batch rows.
 """
 
 from __future__ import annotations
@@ -197,13 +200,13 @@ class PipelineNet:
         template = self.stages[0]
         tmpl_inp = self.stage_inputs[0]
 
-        def stage_fn(stage_params, mb):
+        def stage_fn(stage_params, mb, key=None):
             louts = {tmpl_inp: mb}
             out = None
             for name in template:
                 layer = self.net.layers[name]
                 srcs = [louts[src] for src in layer.cfg.srclayers]
-                ctx = Context(batch=None, train=train, rng=None,
+                ctx = Context(batch=None, train=train, rng=key,
                               layer_index=self.net.topo.index(name),
                               mesh=None, compute_dtype=compute_dtype)
                 out = layer.apply(stage_params, srcs, ctx)
@@ -220,8 +223,12 @@ class PipelineNet:
         dp = mesh.shape.get("data", 1)
         batch_axis = ("data" if dp > 1
                       and (b // self.n_micro) % dp == 0 else None)
+        # rng-bearing stage layers (dropout): every (stage, microbatch)
+        # cell draws an independent key folded from the step rng
+        stage_rng = (jax.random.fold_in(rng, 0x9199)
+                     if rng is not None and train else None)
         y = pipeline_apply(mesh, stage_fn, stacked, xm, axis=axis,
-                           batch_axis=batch_axis)
+                           batch_axis=batch_axis, rng=stage_rng)
         last_out = self.stages[-1][-1]
         outputs[last_out] = y.reshape((b,) + y.shape[2:])
 

@@ -1,6 +1,8 @@
 """Elastic/RandomSync cross-slice tier tests (reference algorithm parity:
 param.cc:102-256, param_manager.cc:85-93, worker.cc:44-55)."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -231,3 +233,125 @@ def test_two_replica_groups_converge(param_type):
                                   - np.asarray(center[k]))))
              for k in center]
         assert max(d) < 0.5
+
+
+# ---------------------------------------------------------------------------
+# VERDICT r2 item 3: the async tier over REAL transport — two localhost
+# processes under jax.distributed, one replica each, center exchange as
+# a global-array collective program (DistributedReplicaSet).
+
+
+@pytest.mark.parametrize("param_type,moving_rate",
+                         [("Elastic", 0.9), ("RandomSync", 0.0)])
+def test_distributed_replica_set_two_process_e2e(tmp_path, param_type,
+                                                 moving_rate):
+    """Both replicas' losses decrease AND the distributed center
+    matches the single-process ReplicaSet trajectory on the same
+    seeds (trajectory-exact sequential exchange)."""
+    import json
+    import socket
+    import subprocess
+    import sys
+    import textwrap
+
+    from singa_tpu.core.trainer import Trainer
+    from singa_tpu.data.synthetic import synthetic_image_batches
+    from singa_tpu.parallel.elastic import ReplicaSet
+
+    steps = 12
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text(f"127.0.0.1:{port}\n127.0.0.1\n")
+
+    child = tmp_path / "child.py"
+    child.write_text(textwrap.dedent(f"""
+        import json, sys
+        import numpy as np
+        from singa_tpu.parallel.bootstrap import distributed_init
+
+        pid = int(sys.argv[1])
+        assert distributed_init(procs_id=pid, hostfile=sys.argv[2])
+        import jax
+        from singa_tpu.core.trainer import Trainer
+        from singa_tpu.config.schema import model_config_from_dict
+        from singa_tpu.data.synthetic import synthetic_image_batches
+        from singa_tpu.parallel.elastic import DistributedReplicaSet
+
+        sys.path.insert(0, {str(os.path.dirname(os.path.abspath(__file__)))!r})
+        from test_elastic import _mlp_cfg
+
+        cfg = _mlp_cfg(moving_rate={moving_rate}, sync_frequency=4,
+                       warmup=2, steps={steps},
+                       param_type={param_type!r})
+        tr = Trainer(cfg, {{"data": {{"pixel": (28, 28), "label": ()}}}},
+                     log_fn=lambda s: None, donate=False)
+        drs = DistributedReplicaSet(tr, seed=0)
+        it = synthetic_image_batches(32, seed=11, stream_seed=60 + pid)
+        center, hist = drs.run(it, steps={steps}, seed=0)
+        np.savez(sys.argv[3] + f"/center_{{pid}}.npz",
+                 **{{k: np.asarray(v) for k, v in center.items()}})
+        print("HIST" + str(pid) + json.dumps(
+            [h["loss"] for h in hist]), flush=True)
+    """))
+
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2",
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    for var in ("JAX_NUM_PROCESSES", "JAX_PROCESS_ID",
+                "JAX_COORDINATOR_ADDRESS"):
+        env.pop(var, None)
+    procs = [subprocess.Popen(
+        [sys.executable, str(child), str(i), str(hostfile),
+         str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True) for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc{i} failed:\n{out}"
+
+    hists = {}
+    for i, out in enumerate(outs):
+        for line in out.splitlines():
+            if line.startswith(f"HIST{i}"):
+                hists[i] = json.loads(line[len(f"HIST{i}"):])
+    assert set(hists) == {0, 1}, outs
+
+    # both replicas learn
+    for g in range(2):
+        assert np.mean(hists[g][-3:]) < np.mean(hists[g][:3]), hists[g]
+
+    # single-process simulation on the same seeds
+    cfg = _mlp_cfg(moving_rate=moving_rate, sync_frequency=4, warmup=2,
+                   steps=steps, param_type=param_type)
+    tr = Trainer(cfg, {"data": {"pixel": (28, 28), "label": ()}},
+                 log_fn=lambda s: None, donate=False)
+    rs = ReplicaSet(tr, ngroups=2, seed=0)
+    iters = [synthetic_image_batches(32, seed=11, stream_seed=60 + g)
+             for g in range(2)]
+    center_sim, hist_sim = rs.run(iters, steps=steps, seed=0)
+
+    # per-replica loss trajectories match the simulation
+    for g in range(2):
+        np.testing.assert_allclose(
+            hists[g], [h["loss"] for h in hist_sim[g]],
+            rtol=2e-4, atol=2e-5)
+
+    # the centers match across processes and vs the simulation
+    c0 = np.load(tmp_path / "center_0.npz")
+    c1 = np.load(tmp_path / "center_1.npz")
+    for k in center_sim:
+        np.testing.assert_allclose(c0[k], c1[k], rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(
+            c0[k], np.asarray(center_sim[k]), rtol=1e-4, atol=1e-5)

@@ -1,0 +1,87 @@
+"""End-to-end real-data run (VERDICT r2 item 5): idx files on disk →
+loader tool → shard.dat → NATIVE batch decoder → prefetch → conv.conf
+training, with falling loss.  Proves the "zero CPU compute in the
+inner loop" data story on actual files, not synthetic arrays.
+Reference bar: tools/data_loader/data_loader.cc:97-148 (idx → shard)
++ layer.cc:646-673 (ShardData batching).
+"""
+
+import os
+import struct
+
+import jax
+import numpy as np
+import pytest
+
+from singa_tpu.config import load_model_config
+from singa_tpu.core.trainer import Trainer
+from singa_tpu.data import native, prefetch, resolve_data_source
+from singa_tpu.tools import loader
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_idx(tmp_path, n=512, seed=0):
+    """Learnable MNIST-style idx pair: 10 class templates + noise."""
+    rng = np.random.default_rng(seed)
+    templates = rng.integers(0, 256, (10, 28, 28)).astype(np.float32)
+    labels = rng.integers(0, 10, n).astype(np.uint8)
+    imgs = np.clip(templates[labels]
+                   + rng.normal(0, 16.0, (n, 28, 28)), 0, 255
+                   ).astype(np.uint8)
+    ip = tmp_path / "train-images-idx3-ubyte"
+    lp = tmp_path / "train-labels-idx1-ubyte"
+    with open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(imgs.tobytes())
+    with open(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+    return str(ip), str(lp)
+
+
+def test_idx_to_shard_to_native_training(tmp_path, monkeypatch):
+    images, labels_f = _write_idx(tmp_path)
+    out = tmp_path / "mnist_train_shard"
+
+    # 1. the loader tool (the reference's `loader` binary role)
+    rc = loader.main(["create", "mnist", images, labels_f, str(out)])
+    assert rc == 0
+    assert (out / "shard.dat").exists()
+
+    # 2. the native C++ decoder must be live and actually used
+    assert native.load_library() is not None, \
+        "native/libsinga_native.so not built"
+    calls = {"n": 0}
+    real = native.decode_image_batch
+
+    def spy(vals):
+        r = real(vals)
+        if r is not None:
+            calls["n"] += 1
+        return r
+    monkeypatch.setattr(native, "decode_image_batch", spy)
+
+    # 3. the reference's own conv.conf, pointed at the shard
+    cfg = load_model_config(
+        os.path.join(REPO, "examples/mnist/conv.conf"))
+    cfg.train_steps = 80
+    cfg.display_frequency = 0
+    cfg.test_frequency = 0
+    for layer in cfg.neuralnet.layer:
+        if layer.data_param:
+            layer.data_param.batchsize = 64
+            layer.data_param.path = str(out)
+
+    train_iter, _ = resolve_data_source(cfg, 64)
+    tr = Trainer(cfg, {"data": {"pixel": (28, 28), "label": ()}},
+                 log_fn=lambda s: None, donate=False)
+    params, opt = tr.init(seed=0)
+    losses = []
+    tr.run(params, opt, train_iter,
+           hooks=[lambda step, m: losses.append(float(m["loss"]))])
+
+    assert len(losses) == 80
+    first, last = np.mean(losses[:5]), np.mean(losses[-5:])
+    assert last < first * 0.7, (first, last)
+    assert calls["n"] > 0, "native batch decoder was never used"
