@@ -186,16 +186,18 @@ class Trainer:
     #: TPU compiler options for conv-family step programs.  The
     #: scoped-VMEM budget (default 16MB) caps XLA's fusion depth; 96MB
     #: measured 136ms -> 128ms on the AlexNet gate workload (bigger
-    #: conv/LRN fusions stop splitting), while 128MB tips into
-    #: catastrophic spills (2.8s/step) — swept on a v5e chip
-    #: (tools/xla_flag_sweep.py ran the env-flag variant; the working
-    #: path is jit(compiler_options=...), which the axon compile helper
-    #: forwards per-compile).  The transformer family REGRESSES under
-    #: the raised budget (0.201 -> 0.179 MFU — it shrinks the VMEM left
-    #: to the Pallas flash kernels), and LeNet-scale convs HANG the
-    #: compile under it, so the option applies only to nets whose
-    #: widest convolution has >= 96 filters (see _compiler_options).
-    TPU_CONV_COMPILER_OPTIONS = {"xla_tpu_scoped_vmem_limit_kib": "98304"}
+    #: conv/LRN fusions stop splitting), 112MB another -0.5..-0.9ms
+    #: (confirmed by two same-process A/Bs at different window sizes),
+    #: 120MB slightly worse again, and 128MB tips into catastrophic
+    #: spills (2.8s/step) — swept on a v5e chip (tools/mfu_ab.py;
+    #: the working path is jit(compiler_options=...), which the axon
+    #: compile helper forwards per-compile).  The transformer family
+    #: REGRESSES under the raised budget (0.201 -> 0.179 MFU — it
+    #: shrinks the VMEM left to the Pallas flash kernels), and
+    #: LeNet-scale convs HANG the compile under it, so the option
+    #: applies only to nets whose widest convolution has >= 96 filters
+    #: (see _compiler_options).
+    TPU_CONV_COMPILER_OPTIONS = {"xla_tpu_scoped_vmem_limit_kib": "114688"}
 
     def _compiler_options(self):
         from ..ops.attention import _on_tpu
