@@ -190,7 +190,7 @@ def bench_quick_mfu(batch_size=2048, iters=50, reps=3,
             "img_sec": round(batch_size / step_s, 1)}
 
 
-def bench_transformer_mfu(batch_size=8, seq_len=1024, iters=50,
+def bench_transformer_mfu(batch_size=32, seq_len=1024, iters=30,
                           precision="bfloat16"):
     import jax
 

@@ -55,11 +55,18 @@ def _largest_divisor_leq(n: int, target: int) -> int:
 
 def chunked_lm_xent(h: jnp.ndarray, w: jnp.ndarray, labels: jnp.ndarray,
                     chunk_size: int = 4096, topk: int = 1,
-                    scale: float = 1.0) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                    scale: float = 1.0,
+                    w_is_vE: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Fused LM-head projection + softmax-xent + top-k precision that
     never materializes the (N, V) logits.
 
-    h: (N, E) token activations; w: (E, V) head weight; labels: (N,).
+    h: (N, E) token activations; w: (E, V) head weight — or, with
+    `w_is_vE`, the (V, E) embedding-table layout used by tied heads:
+    the projection then contracts E on the LAST dim of both operands
+    (dot_general), so no transposed copy of the table is ever
+    materialized (the `w.T` form cost ~1-2 ms/step extra on the 32k-
+    vocab bench stack, worse with an f32 master table since the
+    transpose materialized in f32).  labels: (N,).
     Tokens are processed in chunks inside a lax.scan with jax.checkpoint:
     each chunk's logits exist only in the fused projection+logsumexp
     kernel and are recomputed in the backward — O(chunk·V) live memory
@@ -75,7 +82,12 @@ def chunked_lm_xent(h: jnp.ndarray, w: jnp.ndarray, labels: jnp.ndarray,
 
     @jax.checkpoint
     def chunk_stats(hc, lc):
-        logits = jnp.dot(hc, w, preferred_element_type=jnp.float32)
+        if w_is_vE:
+            logits = jax.lax.dot_general(
+                hc, w, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        else:
+            logits = jnp.dot(hc, w, preferred_element_type=jnp.float32)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         ll = jnp.take_along_axis(logits, lc[:, None], axis=-1)[:, 0]
         if topk == 1:

@@ -71,3 +71,21 @@ def test_bad_field_fails_loud():
 def test_textproto_field_parses():
     cfg = model_config_from_dict({"name": "x", "scoped_vmem": "on"})
     assert cfg.scoped_vmem == "on"
+
+
+def test_attention_family_gets_modest_budget(monkeypatch):
+    from singa_tpu.models.transformer import transformer_lm
+    cfg = transformer_lm(vocab_size=64, num_layers=1, embed_dim=32,
+                         num_heads=2, head_dim=16, seq_len=32,
+                         batchsize=4)
+    shapes = {"data": {"input": (32,), "target": (32,)}}
+    assert _opts(cfg, shapes,
+                 monkeypatch) == Trainer.TPU_ATTN_COMPILER_OPTIONS
+    # "on" must force the FAMILY budget, never the conv-sized one
+    # (which starves the flash kernels)
+    cfg2 = transformer_lm(vocab_size=64, num_layers=1, embed_dim=32,
+                          num_heads=2, head_dim=16, seq_len=32,
+                          batchsize=4)
+    cfg2.scoped_vmem = "on"
+    assert _opts(cfg2, shapes,
+                 monkeypatch) == Trainer.TPU_ATTN_COMPILER_OPTIONS

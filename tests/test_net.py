@@ -231,8 +231,11 @@ def test_fused_relu_lrn_net_matches_unfused():
     params = fused.init_params(jax.random.PRNGKey(0))
 
     def loss_of(net):
+        # rng: the kRGBImage per-image mirror (train-time) draws it;
+        # same key both nets → identical flips → comparable grads
         return jax.value_and_grad(
-            lambda p: net.apply(p, batch, train=True)[0])(params)
+            lambda p: net.apply(p, batch, rng=jax.random.PRNGKey(1),
+                                train=True)[0])(params)
 
     l1, g1 = loss_of(fused)
     l2, g2 = loss_of(unfused)
