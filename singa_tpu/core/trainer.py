@@ -141,12 +141,22 @@ class Trainer:
                      for l in self.cfg.neuralnet.layer)
         if not (has_pipe and staged):
             return {}
-        from ..parallel.pipeline_net import PipelineNet
+        from ..parallel.pipeline_net import (HeteroPipelineNet,
+                                             NonUniformStages,
+                                             PipelineNet)
         n_micro = n_micro or 2 * mesh.shape["pipe"]
         nets = {}
         for net in (self.train_net, self.test_net, self.val_net):
             if net is not None:
-                nets[id(net)] = PipelineNet(net, n_micro)
+                try:
+                    nets[id(net)] = PipelineNet(net, n_micro)
+                except NonUniformStages as e:
+                    # the reference pipelines arbitrary locationid
+                    # layouts (neuralnet.cc:198-323); non-stackable
+                    # stages take the switch-dispatch form
+                    self.log(f"pipeline: stages not SPMD-stackable "
+                             f"({e}); using HeteroPipelineNet")
+                    nets[id(net)] = HeteroPipelineNet(net, n_micro)
         return nets
 
     def _net_apply(self, net):

@@ -74,8 +74,23 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable[..., jnp.ndarray],
 
     p_spec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
 
-    def local(params, xm):
+    def call(stage, params, inp, key):
         params = jax.tree_util.tree_map(lambda p: p[0], params)
+        if key is None:
+            return stage_fn(params, inp)
+        return stage_fn(params, inp, key)
+
+    return _schedule(mesh, call, stage_params, x, axis, x_spec, p_spec,
+                     rng, nstages, n_micro)
+
+
+def _schedule(mesh, call, stage_params, x, axis, x_spec, p_spec, rng,
+              nstages, n_micro):
+    """The GPipe fill-drain schedule shared by the uniform (stacked
+    SPMD stages) and heterogeneous (lax.switch branches) pipelines.
+    `call(stage, params, inp, key)` runs one stage tick."""
+
+    def local(params, xm):
         stage = jax.lax.axis_index(axis)
         total = n_micro + nstages - 1
         fwd_perm = [(i, i + 1) for i in range(nstages - 1)]
@@ -90,11 +105,9 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable[..., jnp.ndarray],
             x_t = jax.lax.dynamic_index_in_dim(
                 xm, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
             inp = jnp.where(stage == 0, x_t.astype(state.dtype), state)
-            if stage_rng is None:
-                out = stage_fn(params, inp)
-            else:
-                out = stage_fn(params, inp,
-                               jax.random.fold_in(stage_rng, m_idx))
+            key = (None if stage_rng is None
+                   else jax.random.fold_in(stage_rng, m_idx))
+            out = call(stage, params, inp, key)
             oidx = jnp.clip(t - (nstages - 1), 0, n_micro - 1)
             updated = jax.lax.dynamic_update_index_in_dim(
                 outputs, out, oidx, 0)
@@ -114,3 +127,30 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable[..., jnp.ndarray],
 
     return shard_map(local, mesh=mesh, in_specs=(p_spec, x_spec),
                      out_specs=x_spec, check_vma=False)(stage_params, x)
+
+
+def pipeline_apply_hetero(mesh, branch_fn, params, x,
+                          axis: str = "pipe",
+                          batch_axis: str | None = None,
+                          rng: jax.Array | None = None) -> jnp.ndarray:
+    """GPipe schedule for NON-uniform stages: every boundary tensor is
+    flattened and zero-padded to one (micro_batch, max_flat) buffer so
+    the ppermute hop has a single SPMD shape, and each device runs its
+    own structure via `branch_fn(stage, params, flat_mb, key)`
+    (lax.switch inside).  `params` is the full resolved param dict,
+    REPLICATED on every device (heterogeneous stages cannot stack) —
+    the memory tradeoff that buys arbitrary per-stage structure, the
+    reference's bridge-layer generality (neuralnet.cc:198-323).
+    """
+    x_spec = P(None, batch_axis) if batch_axis else P()
+    p_spec = jax.tree_util.tree_map(lambda _: P(), params)
+    nstages = mesh.shape[axis]
+    n_micro = x.shape[0]
+    # nstages == 1 is unreachable from HeteroPipelineNet (the trainer
+    # only pipelines a pipe axis > 1) and the schedule handles it
+    # degenerately anyway (empty ppermute), so no fast path exists.
+    if n_micro < nstages:
+        raise ValueError(f"n_micro ({n_micro}) must be >= pipeline "
+                         f"stages ({nstages}) to fill the pipeline")
+    return _schedule(mesh, branch_fn, params, x, axis, x_spec, p_spec,
+                     rng, nstages, n_micro)

@@ -53,6 +53,11 @@ class PipelineError(ValueError):
     pass
 
 
+class NonUniformStages(PipelineError):
+    """Stages exist but are not SPMD-stackable (different structure or
+    wiring) — the trainer falls back to HeteroPipelineNet."""
+
+
 def stage_assignment(net: NeuralNet) -> Tuple[List[str], List[List[str]],
                                               List[str]]:
     """(pre, stages, post) layer-name groups from locationid, in the
@@ -95,7 +100,7 @@ def _validate_uniform(net: NeuralNet, stages: List[List[str]]) -> None:
         si = [net.param_specs[p].shape
               for p in _stage_param_names(net, st)]
         if ti != t0 or si != s0:
-            raise PipelineError(
+            raise NonUniformStages(
                 f"stage {i} is not structurally identical to stage 1: "
                 f"types {ti} vs {t0}, param shapes {si} vs {s0}")
 
@@ -116,6 +121,201 @@ def _external_input(net: NeuralNet, stage: List[str]) -> str:
     return uniq[0]
 
 
+# ---------------------------------------------------------------------------
+# scaffolding shared by the uniform (PipelineNet) and heterogeneous
+# (HeteroPipelineNet) forms — ONE definition of the mesh checks, the
+# pre/post group application, the dp/batch_axis heuristic, and the
+# stage-rng fold, so the two pipelines cannot drift apart.
+
+
+def _check_mesh(pnet, mesh, axis):
+    if mesh is None or axis not in mesh.shape:
+        raise PipelineError(f"{type(pnet).__name__}.apply needs a mesh "
+                            f"with a {axis!r} axis")
+    if mesh.shape[axis] != pnet.n_stages:
+        # the schedule holds exactly one stage per pipe row; a
+        # mismatch would silently drop stages
+        raise PipelineError(
+            f"{pnet.n_stages} locationid stages need pipe axis of "
+            f"the same size, mesh has {axis}={mesh.shape[axis]}")
+
+
+def _pre_apply(pnet, params, batch, rng, train, mesh, compute_dtype,
+               step, outputs, metrics):
+    """Run the pre group; returns (train, total_loss, staged_input)."""
+    if train is None:
+        train = pnet.net.phase == "kTrain"
+    total_loss, m, _ = pnet.net.apply(
+        params, batch, rng=rng, train=train, mesh=mesh,
+        compute_dtype=compute_dtype, layer_subset=pnet.pre,
+        outputs=outputs, step=step)
+    metrics.update(m)
+    x = outputs[pnet.stage_inputs[0]]
+    if x.shape[0] % pnet.n_micro:
+        raise PipelineError(f"batch {x.shape[0]} not divisible by "
+                            f"n_micro {pnet.n_micro}")
+    return train, total_loss, x
+
+
+def _post_apply(pnet, params, batch, rng, train, mesh, compute_dtype,
+                step, outputs, metrics, total_loss):
+    post_loss, m, _ = pnet.net.apply(
+        params, batch, rng=rng, train=train, mesh=mesh,
+        compute_dtype=compute_dtype, layer_subset=pnet.post,
+        outputs=outputs, step=step)
+    metrics.update(m)
+    return total_loss + post_loss, metrics, outputs
+
+
+def _data_batch_axis(mesh, micro_rows):
+    """Shard microbatch rows over "data" so dp groups pipeline
+    different batch slices; replicated (correct, just wasteful) when
+    the rows don't divide."""
+    dp = mesh.shape.get("data", 1)
+    return "data" if dp > 1 and micro_rows % dp == 0 else None
+
+
+def _stage_rng(rng, train):
+    """Per-(stage, microbatch) key base for rng-bearing stage layers."""
+    import jax as _jax
+    return (_jax.random.fold_in(rng, 0x9199)
+            if rng is not None and train else None)
+
+
+class HeteroPipelineNet:
+    """Pipeline parallelism for NON-uniform stages — the reference's
+    actual bridge-layer use case: a conv net whose locationid marks cut
+    it into structurally DIFFERENT stages (conv stage, fc stage, ...),
+    any legal wiring (neuralnet.cc:198-323 inserts bridges for
+    arbitrary layouts).
+
+    Mechanism (see pipeline_apply_hetero): the GPipe ppermute schedule
+    needs one SPMD hop shape, so every boundary activation is flattened
+    and zero-padded to the widest boundary; each device selects its own
+    stage body with lax.switch on the pipe-axis index and
+    unflattens/reflattens at its boundary shapes.  Params are
+    replicated on every pipe row (heterogeneous shapes cannot stack) —
+    a memory tradeoff that is cheap at the conv-net scales this exists
+    for.  Constraints kept from the SPMD form: each stage consumes
+    exactly ONE tensor from the previous stage (any layer of it, not
+    just the last) and exactly one tensor crosses out of the staged
+    region into the post group.  Rng-bearing layers are supported the
+    same way (per (stage, microbatch) key).
+    """
+
+    def __init__(self, net: NeuralNet, n_micro: int):
+        self.net = net
+        self.n_micro = n_micro
+        self.pre, self.stages, self.post = stage_assignment(net)
+        self.stage_inputs = [_external_input(net, st)
+                             for st in self.stages]
+        for s in range(1, len(self.stages)):
+            if self.stage_inputs[s] not in self.stages[s - 1]:
+                raise PipelineError(
+                    f"stage {s + 1} consumes {self.stage_inputs[s]!r}, "
+                    f"which is not in stage {s}")
+        staged_names = {n for st in self.stages for n in st}
+        finals = {src for name in self.post
+                  for src in net.layers[name].cfg.srclayers
+                  if src in staged_names}
+        if len(finals) != 1:
+            raise PipelineError(
+                f"exactly one staged tensor may cross into the post "
+                f"group, found {sorted(finals)}")
+        self.final = next(iter(finals))
+        if self.final not in self.stages[-1]:
+            raise PipelineError(
+                f"the post group consumes {self.final!r}, which is not "
+                f"in the last stage")
+        # boundary layer whose output each stage forwards
+        self.forwarded = [self.stage_inputs[s + 1]
+                          for s in range(len(self.stages) - 1)]
+        self.forwarded.append(self.final)
+
+    @property
+    def n_stages(self) -> int:
+        return len(self.stages)
+
+    def _mb_shape(self, layer_name: str) -> tuple:
+        shape = self.net.layers[layer_name].out_shape
+        return (shape[0] // self.n_micro,) + tuple(shape[1:])
+
+    def apply(self, params, batch, rng=None, train: Optional[bool] = None,
+              mesh=None, compute_dtype=None, axis: str = "pipe",
+              remat: bool = True, step=None):
+        import numpy as _np
+
+        from .pipeline import pipeline_apply_hetero
+
+        _check_mesh(self, mesh, axis)
+        outputs: Dict[str, Any] = {}
+        metrics: Dict[str, jnp.ndarray] = {}
+        train, total_loss, x = _pre_apply(
+            self, params, batch, rng, train, mesh, compute_dtype, step,
+            outputs, metrics)
+        b = x.shape[0]
+        mb = b // self.n_micro
+        in_shapes = [self._mb_shape(n) for n in self.stage_inputs]
+        out_shapes = [self._mb_shape(n) for n in self.forwarded]
+        # per-microbatch-row flat widths; buffers are (mb, maxflat)
+        maxflat = max(int(_np.prod(s[1:]))
+                      for s in in_shapes + out_shapes)
+        buf_dtype = x.dtype
+
+        full = self.net._resolve_params(params)
+
+        def make_branch(s):
+            stage, inp_name = self.stages[s], self.stage_inputs[s]
+            ishape, oshape = in_shapes[s], out_shapes[s]
+
+            def branch(prms, flat_in, key):
+                # batch-polymorphic: under batch_axis sharding the
+                # local microbatch rows are mb / dp
+                xin = flat_in[:, :int(_np.prod(ishape[1:]))]
+                xin = xin.reshape((flat_in.shape[0],)
+                                  + tuple(ishape[1:])).astype(buf_dtype)
+                louts = {inp_name: xin}
+                for name in stage:
+                    layer = self.net.layers[name]
+                    srcs = [louts[src] for src in layer.cfg.srclayers]
+                    ctx = Context(batch=None, train=train, rng=key,
+                                  layer_index=self.net.topo.index(name),
+                                  mesh=None, compute_dtype=compute_dtype)
+                    louts[name] = layer.apply(prms, srcs, ctx)
+                y = louts[self.forwarded[s]].reshape(
+                    flat_in.shape[0], -1)
+                pad = maxflat - y.shape[1]
+                y = jnp.pad(y.astype(buf_dtype), ((0, 0), (0, pad)))
+                return y
+
+            return jax.checkpoint(branch) if remat else branch
+
+        branches = [make_branch(s) for s in range(self.n_stages)]
+
+        def branch_fn(stage, prms, flat_in, key):
+            if key is None:
+                return jax.lax.switch(
+                    stage, [lambda a, s=s: branches[s](prms, a, None)
+                            for s in range(self.n_stages)], flat_in)
+            return jax.lax.switch(
+                stage, [lambda a, k, s=s: branches[s](prms, a, k)
+                        for s in range(self.n_stages)], flat_in, key)
+
+        xm = x.reshape(self.n_micro, mb, -1).astype(buf_dtype)
+        xm = jnp.pad(xm, ((0, 0), (0, 0), (0, maxflat - xm.shape[2])))
+        y = pipeline_apply_hetero(
+            mesh, branch_fn, full, xm, axis=axis,
+            batch_axis=_data_batch_axis(mesh, mb),
+            rng=_stage_rng(rng, train))
+        oshape = out_shapes[-1]
+        osz = int(_np.prod(oshape[1:]))
+        y = y[:, :, :osz].reshape((b,) + tuple(oshape[1:]))
+        outputs[self.final] = y
+        return _post_apply(self, params, batch, rng, train, mesh,
+                           compute_dtype, step, outputs, metrics,
+                           total_loss)
+
+
 class PipelineNet:
     """Pipelined evaluator over a built NeuralNet (see module doc)."""
 
@@ -131,7 +331,7 @@ class PipelineNet:
         # layer of the previous stage would silently get wrong numerics
         for s in range(1, len(self.stages)):
             if self.stage_inputs[s] != self.stages[s - 1][-1]:
-                raise PipelineError(
+                raise NonUniformStages(
                     f"stage {s + 1} must consume stage {s}'s last layer "
                     f"{self.stages[s - 1][-1]!r}, not "
                     f"{self.stage_inputs[s]!r}")
@@ -140,7 +340,7 @@ class PipelineNet:
         for name in self.post:
             for src in net.layers[name].cfg.srclayers:
                 if src in staged_names and src != last:
-                    raise PipelineError(
+                    raise NonUniformStages(
                         f"post layer {name!r} consumes mid-stage layer "
                         f"{src!r}; only the final stage output "
                         f"{last!r} crosses out of the pipeline")
@@ -169,32 +369,13 @@ class PipelineNet:
         The pre/post groups run through NeuralNet.apply(layer_subset=…)
         so their per-layer semantics (fuse_from, remat, aux losses)
         stay identical to the unpipelined net."""
-        if mesh is None or axis not in mesh.shape:
-            raise PipelineError(f"PipelineNet.apply needs a mesh with a "
-                                f"{axis!r} axis")
-        if mesh.shape[axis] != self.n_stages:
-            # the schedule holds exactly one stage per pipe row; a
-            # mismatch would silently drop stages (local() applies only
-            # its first slice)
-            raise PipelineError(
-                f"{self.n_stages} locationid stages need pipe axis of "
-                f"the same size, mesh has {axis}={mesh.shape[axis]}")
-        if train is None:
-            train = self.net.phase == "kTrain"
+        _check_mesh(self, mesh, axis)
         outputs: Dict[str, Any] = {}
         metrics: Dict[str, jnp.ndarray] = {}
-
-        total_loss, m, _ = self.net.apply(
-            params, batch, rng=rng, train=train, mesh=mesh,
-            compute_dtype=compute_dtype, layer_subset=self.pre,
-            outputs=outputs, step=step)
-        metrics.update(m)
-
-        x = outputs[self.stage_inputs[0]]
+        train, total_loss, x = _pre_apply(
+            self, params, batch, rng, train, mesh, compute_dtype, step,
+            outputs, metrics)
         b = x.shape[0]
-        if b % self.n_micro:
-            raise PipelineError(f"batch {b} not divisible by n_micro "
-                                f"{self.n_micro}")
         xm = x.reshape((self.n_micro, b // self.n_micro) + x.shape[1:])
 
         template = self.stages[0]
@@ -217,24 +398,12 @@ class PipelineNet:
             stage_fn = jax.checkpoint(stage_fn)
 
         stacked = self._stack_params(params)
-        # shard microbatches over "data" so dp groups pipeline different
-        # batch slices; falls back to replicated work when the
-        # microbatch doesn't divide (correct either way — just wasteful)
-        dp = mesh.shape.get("data", 1)
-        batch_axis = ("data" if dp > 1
-                      and (b // self.n_micro) % dp == 0 else None)
-        # rng-bearing stage layers (dropout): every (stage, microbatch)
-        # cell draws an independent key folded from the step rng
-        stage_rng = (jax.random.fold_in(rng, 0x9199)
-                     if rng is not None and train else None)
-        y = pipeline_apply(mesh, stage_fn, stacked, xm, axis=axis,
-                           batch_axis=batch_axis, rng=stage_rng)
+        y = pipeline_apply(
+            mesh, stage_fn, stacked, xm, axis=axis,
+            batch_axis=_data_batch_axis(mesh, b // self.n_micro),
+            rng=_stage_rng(rng, train))
         last_out = self.stages[-1][-1]
         outputs[last_out] = y.reshape((b,) + y.shape[2:])
-
-        post_loss, m, _ = self.net.apply(
-            params, batch, rng=rng, train=train, mesh=mesh,
-            compute_dtype=compute_dtype, layer_subset=self.post,
-            outputs=outputs, step=step)
-        metrics.update(m)
-        return total_loss + post_loss, metrics, outputs
+        return _post_apply(self, params, batch, rng, train, mesh,
+                           compute_dtype, step, outputs, metrics,
+                           total_loss)

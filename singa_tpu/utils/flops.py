@@ -145,5 +145,13 @@ def net_forward_flops(net) -> int:
 
 def net_train_flops(net) -> int:
     """Train-step model FLOPs: backward re-does each matmul twice
-    (d-input + d-weight), so 3x forward — the standard convention."""
+    (d-input + d-weight), so 3x forward — the standard convention.
+
+    NOTE the convention counts 3x for the FIRST trainable layer too,
+    whose input gradient XLA never computes (its input is data).  On
+    the AlexNet bench stack that is conv1's dgrad, ~2% of total train
+    FLOPs — i.e. the convention-free MFU is ~0.51 when the reported
+    one is ~0.52.  Kept because every published MFU number (PaLM-style
+    6ND etc.) uses the same uniform-3x convention and comparability
+    matters more than the 2%."""
     return 3 * net_forward_flops(net)
