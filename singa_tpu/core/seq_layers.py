@@ -420,9 +420,16 @@ class LMHeadLossLayer(Layer, _HeadProjection):
         self.flops_shape = (b, s, e, p.vocab_size)   # for utils.flops
         self.out_shape = (2,)
 
-    def apply(self, params, srcs, ctx):
+    def _use_fused(self, h2, w, is_vE) -> bool:
+        """Whether the fused Pallas forward applies: tied (V, E)
+        layout, top-1 metric, kernel-legal shapes, real TPU."""
         from ..ops.attention import _on_tpu
-        from ..ops.head_loss import eligible, fused_lm_xent
+        from ..ops.head_loss import eligible
+        return (self.topk == 1 and is_vE and _on_tpu()
+                and eligible(h2, w))
+
+    def apply(self, params, srcs, ctx):
+        from ..ops.head_loss import fused_lm_xent
         from ..ops.loss import chunked_lm_xent
         hidden, labels = srcs
         w, is_vE = self.head_weight(params, ctx.compute_dtype)
@@ -431,8 +438,7 @@ class LMHeadLossLayer(Layer, _HeadProjection):
         # fused Pallas forward (one pass over vocab blocks, logits
         # VMEM-only — ops/head_loss.py) for tied heads at kernel-legal
         # shapes; the chunked XLA path covers everything else
-        if (self.topk == 1 and is_vE and _on_tpu()
-                and eligible(h2, w)):
+        if self._use_fused(h2, w, is_vE):
             loss, prec = fused_lm_xent(h2, w, l2, self.scale,
                                        self.chunk)
             return {"loss": loss, "precision": prec}

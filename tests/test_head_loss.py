@@ -91,3 +91,32 @@ def test_label_logit_exact():
     loss_d, _ = softmax_loss_metrics(logits.astype(jnp.float32), labels)
     assert float(prec_f) == 1.0
     np.testing.assert_allclose(float(loss_f), float(loss_d), rtol=1e-5)
+
+
+def test_layer_gating(monkeypatch):
+    """The LMHeadLoss layer selects the fused kernel exactly when the
+    head is tied, top-1, kernel-legal, and on a real TPU."""
+    import singa_tpu.ops.attention as attention
+    from singa_tpu.core.net import build_net
+    from singa_tpu.models.transformer import transformer_lm
+
+    cfg = transformer_lm(vocab_size=2048, num_layers=1, embed_dim=128,
+                         num_heads=2, head_dim=64, seq_len=128,
+                         batchsize=4)
+    net = build_net(cfg, "kTrain", {"data": {"input": (128,),
+                                             "target": (128,)}})
+    layer = net.layers["loss"]
+    h2 = jnp.zeros((4 * 128, 128), jnp.bfloat16)      # N=512, E=128
+    w = jnp.zeros((2048, 128), jnp.bfloat16)          # (V, E)
+
+    monkeypatch.setattr(attention, "_on_tpu", lambda: True)
+    assert layer._use_fused(h2, w, True)
+    assert not layer._use_fused(h2, w, False)          # untied (E,V)
+    layer.topk = 5
+    assert not layer._use_fused(h2, w, True)           # top-k > 1
+    layer.topk = 1
+    # shape-illegal: N not a multiple of the token block
+    assert not layer._use_fused(h2[:100], w, True)
+    # off-TPU: always the chunked XLA path
+    monkeypatch.setattr(attention, "_on_tpu", lambda: False)
+    assert not layer._use_fused(h2, w, True)

@@ -142,3 +142,35 @@ def test_convert_lmdb_to_shard_and_train(tmp_path):
     assert px.shape == (8, 3, 8, 8)
     lbl = np.asarray(batch["data"]["label"])
     assert list(lbl) == [i % 10 for i in range(8)]
+
+
+@pytest.mark.parametrize("page_size", [512, 1024, 4096, 16384, 65536])
+def test_roundtrip_across_page_sizes(tmp_path, page_size):
+    """The reader detects the environment's page size from the meta
+    pages — all standard LMDB sizes round-trip."""
+    items = _items(40, vsize=page_size // 8, seed=3)
+    write_lmdb(str(tmp_path), items, page_size=page_size)
+    assert list(iter_lmdb(str(tmp_path))) == items
+
+
+def test_values_straddling_overflow_threshold(tmp_path):
+    """Values on both sides of the in-page/overflow boundary in ONE
+    env: every size from tiny to multi-page must survive."""
+    rng = np.random.default_rng(9)
+    items = [(b"%08d" % i, rng.bytes(size))
+             for i, size in enumerate(
+                 [1, 100, 1900, 1990, 2000, 2100, 4000, 4096, 5000,
+                  12000])]
+    write_lmdb(str(tmp_path), items)
+    got = dict(iter_lmdb(str(tmp_path)))
+    assert {k: len(v) for k, v in got.items()} == {
+        k: len(v) for k, v in items}
+    assert got == dict(items)
+
+
+def test_binary_keys_sort_by_memcmp(tmp_path):
+    """B-tree order is raw-byte order, not text order."""
+    items = [(bytes([b]), b"v%d" % b) for b in (0, 1, 127, 128, 255)]
+    write_lmdb(str(tmp_path), list(reversed(items)))
+    assert [k for k, _ in iter_lmdb(str(tmp_path))] == [
+        k for k, _ in items]
