@@ -70,6 +70,14 @@ def resolve_data_source(model_cfg, batchsize: int, seed: int = 0,
 
     from .pipeline import lmdb_batches
     if train_lmdb and lmdb_ok(train_path):
+        # same decorrelation contract as the shard branch below: the
+        # stream seed only matters through the random_skip draw
+        if stream_seed is not None and not train_skip:
+            import sys as _sys
+            print("warning: distinct data streams requested "
+                  "(stream_seed) but DataProto.random_skip is 0 — "
+                  "LMDB replicas will read identical record order",
+                  file=_sys.stderr)
         train_iter = prefetch(lmdb_batches(
             train_path, batchsize, train_name,
             seed=(stream_seed if stream_seed is not None else seed),

@@ -53,12 +53,16 @@ def lmdb_batches(path: str, batchsize: int, data_layer: str = "data",
 
     rng = np.random.default_rng(seed)
     skip = rng.integers(0, random_skip + 1) if random_skip else 0
+    # partial batches CARRY across epoch boundaries in loop mode (an
+    # env smaller than the batch still fills batches over several
+    # passes instead of silently dropping its records every epoch)
+    vals: List[bytes] = []
     while True:
-        vals: List[bytes] = []
-        usable = 0
+        usable = skipped = 0
         for _, raw in iter_lmdb(path):
             if skip > 0:
                 skip -= 1
+                skipped += 1
                 continue
             rec = record_from_datum(Datum.decode(raw))
             if rec.image is None or not (rec.image.pixel
@@ -69,11 +73,14 @@ def lmdb_batches(path: str, batchsize: int, data_layer: str = "data",
             if len(vals) == batchsize:
                 yield _decode_batch(vals, data_layer)
                 vals = []
-        if loop and not usable:
-            # never spin hot re-reading an empty env forever
+        if loop and not usable and not skipped:
+            # never spin hot re-reading an empty env forever (a pass
+            # fully consumed by a large random_skip is NOT empty — the
+            # leftover skip carries into the next pass, the
+            # shard_batches contract)
             raise ValueError(
                 f"LMDB environment {path!r} contains no usable image "
-                f"records (after random_skip)")
+                f"records")
         if not loop:
             if vals:
                 yield _decode_batch(vals, data_layer)
@@ -87,9 +94,11 @@ def shard_batches(folder: str, batchsize: int, data_layer: str = "data",
     (ShardData semantics, layer.cc:646-673 incl. random_skip)."""
     rng = np.random.default_rng(seed)
     skip = rng.integers(0, random_skip + 1) if random_skip else 0
+    # partial batches carry across epoch boundaries in loop mode (a
+    # shard smaller than the batch still fills batches over passes)
+    vals: List[bytes] = []
     while True:
         shard = Shard(folder, Shard.KREAD)
-        vals: List[bytes] = []
         for i, (_, val) in enumerate(shard):
             if skip > 0:
                 skip -= 1
