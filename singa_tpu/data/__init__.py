@@ -68,31 +68,28 @@ def resolve_data_source(model_cfg, batchsize: int, seed: int = 0,
                 test_path, test_name = layer.data_param.path, layer.name
                 test_lmdb = is_lmdb
 
-    from .pipeline import lmdb_batches
-    if train_lmdb and lmdb_ok(train_path):
-        # same decorrelation contract as the shard branch below: the
-        # stream seed only matters through the random_skip draw
+    def _warn_identical_streams(kind: str) -> None:
+        # stream decorrelation on real sources rides
+        # DataProto.random_skip (layer.cc:646-673): each stream_seed
+        # draws a different initial skip; record order is otherwise
+        # fixed.  Warn when a caller asks for distinct streams but the
+        # config gives no skip budget.
         if stream_seed is not None and not train_skip:
             import sys as _sys
-            print("warning: distinct data streams requested "
-                  "(stream_seed) but DataProto.random_skip is 0 — "
-                  "LMDB replicas will read identical record order",
+            print(f"warning: distinct data streams requested "
+                  f"(stream_seed) but DataProto.random_skip is 0 — "
+                  f"{kind} replicas will read identical record order",
                   file=_sys.stderr)
+
+    from .pipeline import lmdb_batches
+    if train_lmdb and lmdb_ok(train_path):
+        _warn_identical_streams("LMDB")
         train_iter = prefetch(lmdb_batches(
             train_path, batchsize, train_name,
             seed=(stream_seed if stream_seed is not None else seed),
             random_skip=train_skip))
     elif shard_ok(train_path):
-        # stream decorrelation on real shards rides DataProto.random_skip
-        # (layer.cc:646-673): each stream_seed draws a different initial
-        # skip.  File order is otherwise fixed — warn when a caller asks
-        # for distinct streams but the config gives no skip budget.
-        if stream_seed is not None and not train_skip:
-            import sys as _sys
-            print("warning: distinct data streams requested "
-                  "(stream_seed) but DataProto.random_skip is 0 — "
-                  "shard replicas will read identical record order",
-                  file=_sys.stderr)
+        _warn_identical_streams("shard")
         train_iter = prefetch(
             shard_batches(train_path, batchsize, train_name,
                           seed=(stream_seed if stream_seed is not None

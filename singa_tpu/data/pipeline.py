@@ -57,6 +57,7 @@ def lmdb_batches(path: str, batchsize: int, data_layer: str = "data",
     # env smaller than the batch still fills batches over several
     # passes instead of silently dropping its records every epoch)
     vals: List[bytes] = []
+    warned = [False]
     while True:
         usable = skipped = 0
         for _, raw in iter_lmdb(path):
@@ -73,18 +74,34 @@ def lmdb_batches(path: str, batchsize: int, data_layer: str = "data",
             if len(vals) == batchsize:
                 yield _decode_batch(vals, data_layer)
                 vals = []
-        if loop and not usable and not skipped:
-            # never spin hot re-reading an empty env forever (a pass
-            # fully consumed by a large random_skip is NOT empty — the
-            # leftover skip carries into the next pass, the
-            # shard_batches contract)
-            raise ValueError(
-                f"LMDB environment {path!r} contains no usable image "
-                f"records")
+        _pass_end_guard(f"LMDB environment {path!r}", loop, usable,
+                        skipped, warned)
         if not loop:
             if vals:
                 yield _decode_batch(vals, data_layer)
             return
+
+
+def _pass_end_guard(source: str, loop: bool, usable: int, skipped: int,
+                    warned_skip: List[bool]) -> None:
+    """Shared loop-mode sanity for a completed read pass (lmdb_batches
+    and shard_batches both): a pass with neither usable records nor
+    skips means an empty/imageless source — raise instead of spinning
+    hot forever; a pass fully consumed by random_skip is legal (the
+    leftover skip carries) but a skip that large is almost always a
+    config mistake, so warn ONCE about the silent extra passes."""
+    if not loop:
+        return
+    if not usable and not skipped:
+        raise ValueError(
+            f"{source} contains no usable image records")
+    if not usable and skipped and not warned_skip[0]:
+        warned_skip[0] = True
+        import sys
+        print(f"warning: random_skip consumed an entire pass over "
+              f"{source} ({skipped} records) — a skip larger than the "
+              f"dataset costs a full extra scan per multiple before "
+              f"the first batch", file=sys.stderr)
 
 
 def shard_batches(folder: str, batchsize: int, data_layer: str = "data",
@@ -97,19 +114,25 @@ def shard_batches(folder: str, batchsize: int, data_layer: str = "data",
     # partial batches carry across epoch boundaries in loop mode (a
     # shard smaller than the batch still fills batches over passes)
     vals: List[bytes] = []
+    warned = [False]
     while True:
         shard = Shard(folder, Shard.KREAD)
+        usable = skipped = 0
         for i, (_, val) in enumerate(shard):
             if skip > 0:
                 skip -= 1
+                skipped += 1
                 continue
             if not record_has_image(val):
                 continue   # type-only records contribute no batch row
+            usable += 1
             vals.append(val)
             if len(vals) == batchsize:
                 yield _decode_batch(vals, data_layer)
                 vals = []
         shard.close()
+        _pass_end_guard(f"shard folder {folder!r}", loop, usable,
+                        skipped, warned)
         if not loop:
             if vals:  # final partial batch
                 yield _decode_batch(vals, data_layer)

@@ -226,3 +226,31 @@ def test_small_shard_fills_batches_across_epochs(tmp_path):
             sh.insert(b"%08d" % i, rec.encode())
     it = shard_batches(str(tmp_path / "sh"), 8, loop=True)
     assert np.asarray(next(it)["data"]["pixel"]).shape[0] == 8
+
+
+def test_empty_shard_fails_loud_in_loop_mode(tmp_path):
+    """An empty shard.dat as a loop-mode source raises instead of
+    spinning hot forever (the same guard lmdb_batches has)."""
+    from singa_tpu.data.pipeline import shard_batches
+    from singa_tpu.data.shard import Shard
+
+    import os as _os
+    _os.makedirs(tmp_path / "empty", exist_ok=True)
+    with Shard(str(tmp_path / "empty"), Shard.KCREATE):
+        pass
+    with pytest.raises(ValueError, match="no usable"):
+        next(shard_batches(str(tmp_path / "empty"), 4, loop=True))
+
+
+def test_oversized_skip_warns_once(tmp_path, capsys):
+    from singa_tpu.data.pipeline import lmdb_batches
+    rng = np.random.default_rng(11)
+    items = [(b"%08d" % i, Datum(channels=1, height=4, width=4,
+                                 data=rng.bytes(16), label=i).encode())
+             for i in range(4)]
+    write_lmdb(str(tmp_path), items)
+    it = lmdb_batches(str(tmp_path), 2, loop=True, random_skip=30,
+                      seed=1)
+    next(it)
+    err = capsys.readouterr().err
+    assert err.count("consumed an entire pass") == 1
