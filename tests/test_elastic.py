@@ -241,13 +241,15 @@ def test_two_replica_groups_converge(param_type):
 # a global-array collective program (DistributedReplicaSet).
 
 
-@pytest.mark.parametrize("param_type,moving_rate",
-                         [("Elastic", 0.9), ("RandomSync", 0.0)])
-def test_distributed_replica_set_two_process_e2e(tmp_path, param_type,
-                                                 moving_rate):
-    """Both replicas' losses decrease AND the distributed center
+@pytest.mark.parametrize("param_type,moving_rate,nprocs",
+                         [("Elastic", 0.9, 2), ("RandomSync", 0.0, 2),
+                          ("Elastic", 0.9, 3)])
+def test_distributed_replica_set_multiprocess_e2e(tmp_path, param_type,
+                                                 moving_rate, nprocs):
+    """Every replica's losses decrease AND the distributed center
     matches the single-process ReplicaSet trajectory on the same
-    seeds (trajectory-exact sequential exchange)."""
+    seeds (trajectory-exact sequential exchange).  The 3-process case
+    exercises the G>2 sequential center chain."""
     import json
     import socket
     import subprocess
@@ -264,7 +266,8 @@ def test_distributed_replica_set_two_process_e2e(tmp_path, param_type,
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
     hostfile = tmp_path / "hostfile"
-    hostfile.write_text(f"127.0.0.1:{port}\n127.0.0.1\n")
+    hostfile.write_text(f"127.0.0.1:{port}\n"
+                        + "127.0.0.1\n" * (nprocs - 1))
 
     child = tmp_path / "child.py"
     child.write_text(textwrap.dedent(f"""
@@ -309,7 +312,7 @@ def test_distributed_replica_set_two_process_e2e(tmp_path, param_type,
         [sys.executable, str(child), str(i), str(hostfile),
          str(tmp_path)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-        text=True) for i in range(2)]
+        text=True) for i in range(nprocs)]
     outs = []
     try:
         for p in procs:
@@ -326,10 +329,10 @@ def test_distributed_replica_set_two_process_e2e(tmp_path, param_type,
         for line in out.splitlines():
             if line.startswith(f"HIST{i}"):
                 hists[i] = json.loads(line[len(f"HIST{i}"):])
-    assert set(hists) == {0, 1}, outs
+    assert set(hists) == set(range(nprocs)), outs
 
-    # both replicas learn
-    for g in range(2):
+    # every replica learns
+    for g in range(nprocs):
         assert np.mean(hists[g][-3:]) < np.mean(hists[g][:3]), hists[g]
 
     # single-process simulation on the same seeds
@@ -337,21 +340,24 @@ def test_distributed_replica_set_two_process_e2e(tmp_path, param_type,
                    steps=steps, param_type=param_type)
     tr = Trainer(cfg, {"data": {"pixel": (28, 28), "label": ()}},
                  log_fn=lambda s: None, donate=False)
-    rs = ReplicaSet(tr, ngroups=2, seed=0)
+    rs = ReplicaSet(tr, ngroups=nprocs, seed=0)
     iters = [synthetic_image_batches(32, seed=11, stream_seed=60 + g)
-             for g in range(2)]
+             for g in range(nprocs)]
     center_sim, hist_sim = rs.run(iters, steps=steps, seed=0)
 
     # per-replica loss trajectories match the simulation
-    for g in range(2):
+    for g in range(nprocs):
         np.testing.assert_allclose(
             hists[g], [h["loss"] for h in hist_sim[g]],
             rtol=2e-4, atol=2e-5)
 
     # the centers match across processes and vs the simulation
-    c0 = np.load(tmp_path / "center_0.npz")
-    c1 = np.load(tmp_path / "center_1.npz")
+    centers = [np.load(tmp_path / f"center_{g}.npz")
+               for g in range(nprocs)]
     for k in center_sim:
-        np.testing.assert_allclose(c0[k], c1[k], rtol=1e-6, atol=1e-7)
+        for c in centers[1:]:
+            np.testing.assert_allclose(centers[0][k], c[k],
+                                       rtol=1e-6, atol=1e-7)
         np.testing.assert_allclose(
-            c0[k], np.asarray(center_sim[k]), rtol=1e-4, atol=1e-5)
+            centers[0][k], np.asarray(center_sim[k]), rtol=1e-4,
+            atol=1e-5)
