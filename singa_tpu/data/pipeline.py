@@ -59,8 +59,9 @@ def lmdb_batches(path: str, batchsize: int, data_layer: str = "data",
     vals: List[bytes] = []
     warned = [False]
     while True:
-        usable = skipped = 0
+        usable = skipped = seen = 0
         for _, raw in iter_lmdb(path):
+            seen += 1
             if skip > 0:
                 skip -= 1
                 skipped += 1
@@ -75,7 +76,7 @@ def lmdb_batches(path: str, batchsize: int, data_layer: str = "data",
                 yield _decode_batch(vals, data_layer)
                 vals = []
         _pass_end_guard(f"LMDB environment {path!r}", loop, usable,
-                        skipped, warned)
+                        skipped, seen, warned)
         if not loop:
             if vals:
                 yield _decode_batch(vals, data_layer)
@@ -83,19 +84,22 @@ def lmdb_batches(path: str, batchsize: int, data_layer: str = "data",
 
 
 def _pass_end_guard(source: str, loop: bool, usable: int, skipped: int,
-                    warned_skip: List[bool]) -> None:
+                    seen: int, warned_skip: List[bool]) -> None:
     """Shared loop-mode sanity for a completed read pass (lmdb_batches
-    and shard_batches both): a pass with neither usable records nor
-    skips means an empty/imageless source — raise instead of spinning
-    hot forever; a pass fully consumed by random_skip is legal (the
-    leftover skip carries) but a skip that large is almost always a
-    config mistake, so warn ONCE about the silent extra passes."""
+    and shard_batches both): a pass with records but no skips and no
+    usable rows means an empty/imageless source — raise instead of
+    spinning hot forever; a pass consumed ENTIRELY by random_skip is
+    legal (the leftover skip carries) but a skip that large is almost
+    always a config mistake, so warn ONCE about the silent extra
+    passes.  A mixed pass (some skips, rest imageless) neither warns
+    nor raises yet — once the skip budget exhausts, a later pass hits
+    the raise with the accurate message."""
     if not loop:
         return
     if not usable and not skipped:
         raise ValueError(
             f"{source} contains no usable image records")
-    if not usable and skipped and not warned_skip[0]:
+    if not usable and skipped == seen and seen and not warned_skip[0]:
         warned_skip[0] = True
         import sys
         print(f"warning: random_skip consumed an entire pass over "
@@ -117,8 +121,9 @@ def shard_batches(folder: str, batchsize: int, data_layer: str = "data",
     warned = [False]
     while True:
         shard = Shard(folder, Shard.KREAD)
-        usable = skipped = 0
+        usable = skipped = seen = 0
         for i, (_, val) in enumerate(shard):
+            seen += 1
             if skip > 0:
                 skip -= 1
                 skipped += 1
@@ -132,7 +137,7 @@ def shard_batches(folder: str, batchsize: int, data_layer: str = "data",
                 vals = []
         shard.close()
         _pass_end_guard(f"shard folder {folder!r}", loop, usable,
-                        skipped, warned)
+                        skipped, seen, warned)
         if not loop:
             if vals:  # final partial batch
                 yield _decode_batch(vals, data_layer)

@@ -243,7 +243,8 @@ def test_two_replica_groups_converge(param_type):
 
 @pytest.mark.parametrize("param_type,moving_rate,nprocs",
                          [("Elastic", 0.9, 2), ("RandomSync", 0.0, 2),
-                          ("Elastic", 0.9, 3)])
+                          ("Elastic", 0.9, 3),
+                          ("RandomSync", 0.0, 3)])
 def test_distributed_replica_set_multiprocess_e2e(tmp_path, param_type,
                                                  moving_rate, nprocs):
     """Every replica's losses decrease AND the distributed center

@@ -249,8 +249,27 @@ def test_oversized_skip_warns_once(tmp_path, capsys):
                                  data=rng.bytes(16), label=i).encode())
              for i in range(4)]
     write_lmdb(str(tmp_path), items)
+    # precondition: the generator's seeded draw must exceed the
+    # dataset, else no pass is fully consumed and no warning fires
+    draw = np.random.default_rng(1).integers(0, 31)
+    assert draw > len(items), draw
     it = lmdb_batches(str(tmp_path), 2, loop=True, random_skip=30,
                       seed=1)
     next(it)
     err = capsys.readouterr().err
     assert err.count("consumed an entire pass") == 1
+
+
+def test_mixed_skip_and_imageless_pass_raises_accurately(tmp_path):
+    """A pass that is part skip, part image-less records must not
+    blame random_skip — once the skip budget exhausts, the accurate
+    'no usable image records' error surfaces."""
+    from singa_tpu.data.pipeline import lmdb_batches
+    items = [(b"%08d" % i,
+              Datum(label=i).encode())           # image-less Datums
+             for i in range(5)]
+    write_lmdb(str(tmp_path), items)
+    it = lmdb_batches(str(tmp_path), 2, loop=True, random_skip=3,
+                      seed=0)
+    with pytest.raises(ValueError, match="no usable"):
+        next(it)
