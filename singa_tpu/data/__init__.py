@@ -5,6 +5,7 @@ from __future__ import annotations
 import os
 from typing import Callable, Iterator, Tuple
 
+from .discovery import discover_input_shapes
 from .records import Datum, Record, SingleLabelImageRecord
 from .shard import Shard, ShardError
 from .pipeline import Prefetcher, prefetch, shard_batches
@@ -13,7 +14,8 @@ from .synthetic import synthetic_image_batches
 
 def resolve_data_source(model_cfg, batchsize: int, seed: int = 0,
                         force_synthetic: bool = False,
-                        stream_seed: int | None = None
+                        stream_seed: int | None = None,
+                        sample_shapes: dict | None = None
                         ) -> Tuple[Iterator, Callable[[], Iterator]]:
     """Pick (train_iter, test_iter_factory) for a model config: shard
     folders from DataProto.path when they exist locally, else synthetic.
@@ -22,7 +24,18 @@ def resolve_data_source(model_cfg, batchsize: int, seed: int = 0,
     table); `stream_seed` varies only the sample stream — async replica
     groups pass a different stream_seed per replica so they train
     different data of the SAME task (a different `seed` would hand each
-    replica an unrelated task and make their center average garbage)."""
+    replica an unrelated task and make their center average garbage).
+
+    `sample_shapes` (data-layer name → field → per-sample shape, as
+    discovery.discover_input_shapes returns) sizes the synthetic source
+    so it matches the geometry the net was built for — RGB nets get
+    (3, S, S) records, not MNIST's (28, 28).  Omitted, it is derived by
+    the same discovery the Trainer path uses, so a caller can never get
+    batches shaped differently from the net it built."""
+    if sample_shapes is None:
+        from .discovery import discover_input_shapes as _discover
+        sample_shapes = _discover(model_cfg,
+                                  force_synthetic=force_synthetic)
     train_path = test_path = None
     train_name = test_name = "data"
     layers = model_cfg.neuralnet.layer if model_cfg.neuralnet else []
@@ -40,14 +53,15 @@ def resolve_data_source(model_cfg, batchsize: int, seed: int = 0,
             return (mk(stream_seed if stream_seed is not None
                        else seed), (lambda: mk(seed + 7919)))
 
+    # the SAME existence predicates discovery uses to size the net —
+    # the two must never diverge or served batches mismatch the net
+    from .discovery import lmdb_source_exists, shard_source_exists
+
     def shard_ok(p):
-        return (not force_synthetic and p and
-                os.path.isfile(os.path.join(p, "shard.dat")))
+        return not force_synthetic and shard_source_exists(p)
 
     def lmdb_ok(p):
-        return (not force_synthetic and p and
-                (os.path.isfile(p)
-                 or os.path.isfile(os.path.join(p, "data.mdb"))))
+        return not force_synthetic and lmdb_source_exists(p)
 
     train_skip = 0
     train_lmdb = test_lmdb = False
@@ -101,6 +115,7 @@ def resolve_data_source(model_cfg, batchsize: int, seed: int = 0,
         # seeds are unrelated tasks and make test accuracy pure noise
         train_iter = synthetic_image_batches(
             batchsize, data_layer=train_name, seed=seed,
+            image_shape=_pixel_shape(sample_shapes, train_name),
             stream_seed=(stream_seed if stream_seed is not None
                          else seed + 101))
     if test_lmdb and lmdb_ok(test_path):
@@ -112,5 +127,12 @@ def resolve_data_source(model_cfg, batchsize: int, seed: int = 0,
     else:
         test_factory = lambda: synthetic_image_batches(
             batchsize, data_layer=test_name, seed=seed,
+            image_shape=_pixel_shape(sample_shapes, test_name),
             stream_seed=seed + 202)
     return train_iter, test_factory
+
+
+def _pixel_shape(sample_shapes: dict | None, layer_name: str):
+    if sample_shapes and layer_name in sample_shapes:
+        return tuple(sample_shapes[layer_name].get("pixel", (28, 28)))
+    return (28, 28)

@@ -37,6 +37,8 @@ def make_argparser() -> argparse.ArgumentParser:
                     help="use a synthetic learnable dataset (no egress env)")
     ap.add_argument("--steps", type=int, default=None,
                     help="override ModelProto.train_steps")
+    ap.add_argument("--batchsize", type=int, default=0,
+                    help="override every data layer's batchsize")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--resume", action="store_true",
                     help="resume from latest checkpoint in the workspace")
@@ -66,16 +68,18 @@ def main(argv=None) -> int:
     if args.steps is not None:
         model.train_steps = args.steps
 
-    # data-layer discovery: shapes for MNIST-style records
-    input_shapes = {}
-    for layer in (model.neuralnet.layer if model.neuralnet else []):
-        if layer.type in ("kShardData", "kLMDBData"):
-            input_shapes.setdefault(
-                layer.name, {"pixel": (28, 28), "label": ()})
-        elif layer.type == "kSequenceData" and layer.seqdata_param:
-            s = layer.seqdata_param.seq_len
-            input_shapes.setdefault(
-                layer.name, {"input": (s,), "target": (s,)})
+    # data-layer discovery: real sources are peeked for their true
+    # record geometry, synthetic mode infers it from the parser configs
+    # (the reference's Setup-reads-a-record contract, layer.cc:388-392)
+    from .data import discover_input_shapes
+    if args.batchsize:
+        for layer in (model.neuralnet.layer if model.neuralnet else []):
+            if layer.data_param:
+                layer.data_param.batchsize = args.batchsize
+            if layer.seqdata_param:
+                layer.seqdata_param.batchsize = args.batchsize
+    input_shapes = discover_input_shapes(
+        model, force_synthetic=args.synthetic)
 
     # Mesh from the cluster config: engages DP/TP/SP/EP shardings when
     # more than one device is visible (ClusterProto topology → Mesh,
@@ -146,7 +150,8 @@ def main(argv=None) -> int:
         iters = [resolve_data_source(
                      model, bs, seed=args.seed,
                      stream_seed=args.seed + 1000 * (g + 1),
-                     force_synthetic=args.synthetic)[0]
+                     force_synthetic=args.synthetic,
+                     sample_shapes=input_shapes)[0]
                  for g in range(ngroups)]
         center, history = rs.run(iters, model.train_steps,
                                  seed=args.seed)
@@ -157,7 +162,8 @@ def main(argv=None) -> int:
                if last else ""))
         test_factory = resolve_data_source(
             model, bs, seed=args.seed,
-            force_synthetic=args.synthetic)[1]
+            force_synthetic=args.synthetic,
+            sample_shapes=input_shapes)[1]
         if trainer.test_step is not None and test_factory is not None \
                 and center is not None and model.test_steps > 0:
             avg = trainer.evaluate(center, test_factory(),
@@ -188,7 +194,8 @@ def main(argv=None) -> int:
                       "starting from scratch")
 
     train_iter, test_factory = resolve_data_source(
-        model, bs, seed=args.seed, force_synthetic=args.synthetic)
+        model, bs, seed=args.seed, force_synthetic=args.synthetic,
+        sample_shapes=input_shapes)
 
     if mesh is not None:
         from .parallel import (batch_shardings, seq_batch_shardings,

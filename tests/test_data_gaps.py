@@ -92,11 +92,11 @@ def test_lmdb_with_real_env_fails_loud(tmp_path):
             {"name": "loss", "type": "kSoftmaxLoss",
              "srclayers": ["ip", "label"]}]}})
     # r2->r3: the refusal became a real read path (data/lmdb_reader.py);
-    # a corrupt env must still fail loudly, now as a format error when
-    # the first batch is pulled
+    # a corrupt env must still fail loudly — since r4 already at
+    # resolve time, when shape discovery peeks the first record
     from singa_tpu.data.lmdb_reader import LMDBFormatError
-    train_iter, _ = resolve_data_source(cfg, 2)
     with pytest.raises(LMDBFormatError):
+        train_iter, _ = resolve_data_source(cfg, 2)
         next(iter(train_iter))
 
 

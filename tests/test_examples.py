@@ -2,11 +2,13 @@
 the examples ARE the integration suite, as in the reference (SURVEY §4).
 """
 
+import glob
 import subprocess
 import sys
 
 import jax
 import numpy as np
+import pytest
 
 from singa_tpu.config import load_cluster_config, load_model_config
 from singa_tpu.core.trainer import Trainer
@@ -65,6 +67,65 @@ def test_cli_runs_example_end_to_end():
              "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
     assert out.returncode == 0, out.stderr[-2000:]
     assert "mesh: " in out.stdout and "training done" in out.stdout
+
+
+# every shipped model conf — cluster.conf is a ClusterProto, not a model
+MODEL_CONFS = sorted(
+    c for c in glob.glob("examples/**/*.conf", recursive=True)
+    if not c.endswith("cluster.conf"))
+
+
+def test_conf_glob_finds_the_expected_families():
+    fams = {c.split("/")[1] for c in MODEL_CONFS}
+    assert {"mnist", "cifar10", "imagenet", "transformer"} <= fams
+
+
+@pytest.mark.parametrize("conf", MODEL_CONFS)
+def test_every_shipped_conf_trains_through_cli(conf):
+    """conf + binary is the whole interface (main.cc:34-58): every conf
+    we ship must run end to end through the CLI, with input geometry
+    discovered from the net (data/discovery.py), not hardcoded.
+    --batchsize shrinks compute for CPU CI; the layer graph and the
+    discovered shapes are identical to a full run."""
+    out = subprocess.run(
+        [sys.executable, "-m", "singa_tpu.main", "-model_conf", conf,
+         "--synthetic", "--steps", "2", "--batchsize", "8"],
+        capture_output=True, text=True, timeout=900,
+        env={"PYTHONPATH": ".", "PATH": "/usr/bin:/bin",
+             "JAX_PLATFORMS": "cpu", "HOME": "/tmp"})
+    assert out.returncode == 0, (conf, out.stderr[-2000:])
+    assert "training done" in out.stdout, (conf, out.stdout[-500:])
+
+
+def test_discovered_shapes_follow_parser_geometry():
+    from singa_tpu.data import discover_input_shapes
+
+    cases = {"examples/cifar10/quick.conf": (3, 32, 32),
+             "examples/imagenet/alexnet.conf": (3, 256, 256),
+             "examples/mnist/conv.conf": (28, 28)}
+    for conf, want in cases.items():
+        shapes = discover_input_shapes(load_model_config(conf),
+                                       force_synthetic=True)
+        got = next(iter(shapes.values()))["pixel"]
+        assert got == want, (conf, got)
+
+
+def test_discovery_peeks_a_real_shard(tmp_path):
+    """A live source wins over parser inference: the record IS the
+    schema (layer.cc:388-392 reads a sample record in Setup)."""
+    from singa_tpu.data import (Record, Shard, SingleLabelImageRecord,
+                                discover_input_shapes)
+
+    folder = str(tmp_path)
+    with Shard(folder, Shard.KCREATE) as sh:
+        rec = Record(image=SingleLabelImageRecord(
+            shape=[3, 40, 40], label=1, pixel=b"\x00" * (3 * 40 * 40)))
+        sh.insert(b"k0", rec.encode())
+    cfg = load_model_config("examples/cifar10/quick.conf")
+    data = next(l for l in cfg.neuralnet.layer if l.type == "kShardData")
+    data.data_param.path = folder
+    shapes = discover_input_shapes(cfg)
+    assert shapes[data.name]["pixel"] == (3, 40, 40)
 
 
 def test_shipped_example_confs_match_zoo_and_reference():
