@@ -71,8 +71,11 @@ def _parse_meta(buf: bytes, off: int):
 
 
 def _detect_page_size(buf: bytes) -> int:
+    # ps + 152 covers every field _parse_meta unpacks (txnid at
+    # off+16+128, 8 bytes) — a file truncated inside the meta page must
+    # surface as LMDBFormatError, not a raw struct.error
     for ps in _PAGE_SIZES:
-        if len(buf) >= ps + 24 and _parse_meta(buf, ps) is not None:
+        if len(buf) >= ps + 152 and _parse_meta(buf, ps) is not None:
             return ps
     raise LMDBFormatError("no LMDB meta page found at any standard "
                           "page size (is this really an LMDB file?)")

@@ -52,7 +52,9 @@ def lmdb_batches(path: str, batchsize: int, data_layer: str = "data",
     from .records import Datum, record_from_datum
 
     rng = np.random.default_rng(seed)
-    skip = rng.integers(0, random_skip + 1) if random_skip else 0
+    # [0, random_skip-1], the reference's rand() % random_skip_
+    # contract (layer.cc:651-653)
+    skip = rng.integers(0, random_skip) if random_skip else 0
     # partial batches CARRY across epoch boundaries in loop mode (an
     # env smaller than the batch still fills batches over several
     # passes instead of silently dropping its records every epoch)
@@ -114,7 +116,9 @@ def shard_batches(folder: str, batchsize: int, data_layer: str = "data",
     """Batches from a shard folder of Record tuples, in file order
     (ShardData semantics, layer.cc:646-673 incl. random_skip)."""
     rng = np.random.default_rng(seed)
-    skip = rng.integers(0, random_skip + 1) if random_skip else 0
+    # [0, random_skip-1], the reference's rand() % random_skip_
+    # contract (layer.cc:651-653)
+    skip = rng.integers(0, random_skip) if random_skip else 0
     # partial batches carry across epoch boundaries in loop mode (a
     # shard smaller than the batch still fills batches over passes)
     vals: List[bytes] = []

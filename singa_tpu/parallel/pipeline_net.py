@@ -282,10 +282,20 @@ class HeteroPipelineNet:
                                   layer_index=self.net.topo.index(name),
                                   mesh=None, compute_dtype=compute_dtype)
                     louts[name] = layer.apply(prms, srcs, ctx)
-                y = louts[self.forwarded[s]].reshape(
-                    flat_in.shape[0], -1)
+                y = louts[self.forwarded[s]]
+                if y.dtype != buf_dtype:
+                    # the transport buffer carries every boundary in
+                    # one dtype; a silent cast at each hop would
+                    # diverge from the unpipelined net's numerics
+                    raise ValueError(
+                        f"hetero-pipeline boundary "
+                        f"{self.forwarded[s]!r} produces {y.dtype} but "
+                        f"the stage transport buffer is {buf_dtype} "
+                        f"(the staged input's dtype) — run with a "
+                        f"uniform compute_dtype or cast in the net")
+                y = y.reshape(flat_in.shape[0], -1)
                 pad = maxflat - y.shape[1]
-                y = jnp.pad(y.astype(buf_dtype), ((0, 0), (0, pad)))
+                y = jnp.pad(y, ((0, 0), (0, pad)))
                 return y
 
             return jax.checkpoint(branch) if remat else branch
