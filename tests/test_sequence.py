@@ -185,27 +185,37 @@ def test_transformer_sharded_step_matches_local():
                                rtol=2e-3, atol=1e-5)
 
 
-def test_expert_parallel_sharding():
+def test_expert_parallel_sharding_matches_local():
+    """EP on an expert=4 mesh: experts genuinely shard AND the sharded
+    step reproduces single-device numerics (not just finite loss)."""
     mesh = make_mesh(data=2, expert=4)
     cfg = transformer_lm(vocab_size=32, num_layers=2, embed_dim=32,
                          num_heads=2, head_dim=16, seq_len=64, batchsize=8,
                          moe_every=1, num_experts=4)
-    tr = Trainer(cfg, {"data": {"input": (64,), "target": (64,)}},
-                 donate=False, mesh=mesh)
+    shapes = {"data": {"input": (64,), "target": (64,)}}
+    tr = Trainer(cfg, shapes, donate=False, mesh=mesh)
+    tr_local = Trainer(cfg, shapes, donate=False)
     shardings = param_shardings(mesh, tr.train_net)
     from jax.sharding import PartitionSpec as P
     assert shardings["moe0/w1"].spec == P("expert", None, None)
     assert shardings["moe0/b2"].spec == P("expert", None)
-    # sharded step runs and is finite
     params, opt = tr.init(0)
+    batch = next(synthetic_token_batches(8, 64, 32))
+    rng = jax.random.PRNGKey(0)
+    p1, o1, m1 = tr_local.train_step(params, opt, batch, 0, rng)
     sp = {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
     so = {k: {n: jax.device_put(v, shardings[n]) for n, v in t.items()}
           for k, t in opt.items()}
-    batch = next(synthetic_token_batches(8, 64, 32))
     sb = jax.tree_util.tree_map(jax.device_put, batch,
                                 seq_batch_shardings(mesh, batch))
-    p, o, m = tr.train_step(sp, so, sb, 0, jax.random.PRNGKey(0))
-    assert np.isfinite(float(m["loss"]))
+    p2, o2, m2 = tr.train_step(sp, so, sb, 0, rng)
+    assert float(m1["loss"]) == pytest.approx(float(m2["loss"]), rel=1e-4)
+    np.testing.assert_allclose(np.asarray(p1["moe0/w1"]),
+                               np.asarray(p2["moe0/w1"]),
+                               rtol=2e-3, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(p1["embed/embedding"]),
+                               np.asarray(p2["embed/embedding"]),
+                               rtol=2e-3, atol=1e-5)
 
 
 def test_bfloat16_precision_policy():
