@@ -172,11 +172,11 @@ class NeuralNet:
           kLayerPartition → feature (last) dim over "model"
           kNone           → fully replicated
         and XLA compiles the Slice/Concate/Split/Bridge data movement
-        the reference hand-coded for every src→dst combination.  Falls
-        back (with a one-time warning) when the dim doesn't divide the
-        mesh axis — the reference instead gives the remainder to the
-        last partition (neuralnet.cc:160-162), which per-device static
-        shapes cannot express."""
+        the reference hand-coded for every src→dst combination.  A dim
+        that doesn't divide the mesh axis still partitions: GSPMD tiles
+        with an implicit pad on the last shard — the compiler-native
+        form of the reference giving the remainder to the last
+        partition (neuralnet.cc:160-162)."""
         import jax.numpy as _jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -194,15 +194,6 @@ class NeuralNet:
         n = dict(mesh.shape).get(axis, 1)
         if n <= 1:
             return out
-        if out.shape[dim] % n:
-            if name not in self._partition_warned:
-                self._partition_warned.add(name)
-                import sys
-                print(f"warning: layer {name!r} {ptype} dim {dim} "
-                      f"(size {out.shape[dim]}) not divisible by mesh "
-                      f"axis {axis!r}={n}; activation stays replicated",
-                      file=sys.stderr)
-            return out
         spec = [None] * out.ndim
         spec[dim] = axis
         return jax.lax.with_sharding_constraint(
@@ -216,6 +207,31 @@ class NeuralNet:
             if owner not in full:
                 raise LayerError(f"share_param target {owner!r} not found")
             full[alias] = full[owner]
+        return full
+
+    def _constrain_uneven_params(self, full, mesh):
+        """Partition the COMPUTE on params whose partition dim doesn't
+        divide their mesh axis.  Storage for such a param stays
+        replicated (jax.device_put only tiles divisible dims), but an
+        in-step sharding constraint makes GSPMD tile it with an
+        implicit last-shard pad — so e.g. a 10-wide classifier on
+        model=4 runs 3/3/3/1-partitioned, the reference's
+        last-partition-remainder contract (neuralnet.cc:160-162,
+        base_layer.cc:125-129) — instead of silently replicating the
+        matmul a user asked to split."""
+        if mesh is None:
+            return full
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        shape = dict(mesh.shape)
+        for name, spec in self.param_specs.items():
+            dim, axis = spec.partition_dim, (spec.mesh_axis or "model")
+            n = shape.get(axis, 1)
+            if (n > 1 and dim is not None and dim >= 0
+                    and spec.shape[dim] % n and name in full):
+                sp: list = [None] * len(spec.shape)
+                sp[dim] = axis
+                full[name] = jax.lax.with_sharding_constraint(
+                    full[name], NamedSharding(mesh, P(*sp)))
         return full
 
     # -- forward -----------------------------------------------------------
@@ -239,7 +255,8 @@ class NeuralNet:
         """
         if train is None:
             train = self.phase == "kTrain"
-        full = self._resolve_params(params)
+        full = self._constrain_uneven_params(
+            self._resolve_params(params), mesh)
         ctx_batch = batch
         outputs = {} if outputs is None else outputs
         metrics: Dict[str, jnp.ndarray] = {}

@@ -145,7 +145,14 @@ def main(argv=None) -> int:
                       file=sys.stderr)
         print(f"async replica groups: {ngroups} x "
               f"{model.updater.param_type}")
-        rs = ReplicaSet(trainer, ngroups, seed=args.seed)
+        # ClusterProto.bandwidth/nservers drive the runtime SyncConfig
+        # (param_manager.cc:85-93): after warmup the RandomSync sample
+        # ratio adapts to the configured pipe
+        rs = ReplicaSet(trainer, ngroups, seed=args.seed,
+                        bandwidth_mb_s=(cluster.bandwidth
+                                        if cluster else 0.0),
+                        nservers=(cluster.nservers or 1
+                                  if cluster else 1))
         # same task (seed), a distinct sample stream per replica
         iters = [resolve_data_source(
                      model, bs, seed=args.seed,

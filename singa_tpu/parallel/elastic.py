@@ -28,6 +28,7 @@ from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..config.schema import UpdaterConfig
 
@@ -116,13 +117,28 @@ class ElasticController:
     called each step with that slice's params.
     """
 
-    def __init__(self, cfg: UpdaterConfig, ngroups: int = 1):
+    def __init__(self, cfg: UpdaterConfig, ngroups: int = 1,
+                 bandwidth_mb_s: float = 0.0, nservers: int = 1):
         self.cfg = cfg
         self.alpha = easgd_alpha(cfg, ngroups)
         self.mode = cfg.param_type           # "Elastic" | "RandomSync"
         self.center = None
         self.snapshot = None
         self.sample_ratio = 1.0
+        self.bandwidth_mb_s = bandwidth_mb_s
+        self.nservers = max(nservers, 1)
+
+    def configure_sync(self, compute_time_s: float,
+                       model_size_floats: int, nworkers: int) -> None:
+        """Runtime SyncConfig (param_manager.cc:85-93, called with the
+        measured warmup step time, worker.cc:42-48): adapt the
+        RandomSync sample ratio to the configured pipe.  A zero
+        bandwidth (the TPU default — ICI/DCN collectives, not a
+        modelled PS pipe) leaves sampling at 1.0."""
+        if self.bandwidth_mb_s > 0:
+            self.sample_ratio = sync_sample_ratio(
+                self.bandwidth_mb_s, self.nservers, nworkers,
+                model_size_floats, compute_time_s)
 
     def init(self, params) -> None:
         self.center = jax.tree_util.tree_map(jnp.copy, params)
@@ -176,12 +192,14 @@ class ReplicaSet:
     ReplicaSet member per slice with transport via jax.distributed.
     """
 
-    def __init__(self, trainer, ngroups: int, seed: int = 0):
+    def __init__(self, trainer, ngroups: int, seed: int = 0,
+                 bandwidth_mb_s: float = 0.0, nservers: int = 1):
         self.trainer = trainer
         self.ngroups = ngroups
         cfg = trainer.cfg.updater
-        self.controllers = [ElasticController(cfg, ngroups)
-                            for _ in range(ngroups)]
+        self.controllers = [ElasticController(
+            cfg, ngroups, bandwidth_mb_s=bandwidth_mb_s,
+            nservers=nservers) for _ in range(ngroups)]
         self.replicas = []
         for g in range(ngroups):
             # every replica starts from the SAME initialization — the
@@ -205,9 +223,26 @@ class ReplicaSet:
         if len(data_iters) != self.ngroups:
             raise ValueError(f"need {self.ngroups} data iterators, got "
                              f"{len(data_iters)}")
+        import time as _time
+
         rng = jax.random.PRNGKey(seed ^ 0xA57)
         history = [[] for _ in range(self.ngroups)]
+        warmup = self.trainer.cfg.updater.warmup_steps
+        t_warm = None
         for step in range(steps):
+            # Warmup timing for the bandwidth model (worker.cc:42-48
+            # times the warmup loop, then SyncConfig).  Step 0 is the
+            # jit compile — excluded (the reference's C++ has no
+            # compile step to distort the measurement with).
+            if step == 1 and warmup > 1:
+                t_warm = _time.perf_counter()
+            if step == warmup and t_warm is not None:
+                per_step = ((_time.perf_counter() - t_warm)
+                            / ((warmup - 1) * self.ngroups))
+                size = sum(int(np.prod(v.shape)) for v in
+                           self.replicas[0]["params"].values())
+                for c in self.controllers:
+                    c.configure_sync(per_step, size, self.ngroups)
             for g, rep in enumerate(self.replicas):
                 batch = next(data_iters[g])
                 step_rng = jax.random.fold_in(
@@ -251,7 +286,8 @@ class DistributedReplicaSet:
     coordinator process to fail.
     """
 
-    def __init__(self, trainer, seed: int = 0):
+    def __init__(self, trainer, seed: int = 0,
+                 bandwidth_mb_s: float = 0.0, nservers: int = 1):
         self.trainer = trainer
         self.proc = jax.process_index()
         self.ngroups = jax.process_count()
@@ -262,6 +298,8 @@ class DistributedReplicaSet:
         self._center_global = None            # replicated global array
         self.snapshot = None
         self.sample_ratio = 1.0
+        self.bandwidth_mb_s = bandwidth_mb_s
+        self.nservers = max(nservers, 1)
         self.params, self.opt = trainer.init(seed=seed)
         self._mesh = self._group_mesh()
         self._exchange = None
@@ -399,10 +437,33 @@ class DistributedReplicaSet:
         """Train this process's replica for `steps` steps with center
         exchanges at the UpdaterProto cadence.  Returns (center,
         history) — history is THIS replica's metric list."""
+        import time as _time
+
         rng = jax.random.PRNGKey(seed ^ 0xA57)
         g = self.proc
         history = []
+        warmup = self.cfg.warmup_steps
+        t_warm = None
         for step in range(steps):
+            # Warmup timing -> SyncConfig (worker.cc:42-48), as in the
+            # simulation; every process must agree on ONE ratio (the
+            # exchange takes it as a replicated operand), so the
+            # per-process measurements are averaged across processes.
+            if step == 1 and warmup > 1:
+                t_warm = _time.perf_counter()
+            if (step == warmup and t_warm is not None
+                    and self.bandwidth_mb_s > 0):
+                per_step = (_time.perf_counter() - t_warm) / (warmup - 1)
+                if self.ngroups > 1:
+                    from jax.experimental import multihost_utils
+                    per_step = float(np.mean(
+                        multihost_utils.process_allgather(
+                            np.asarray(per_step, np.float32))))
+                size = sum(int(np.prod(v.shape))
+                           for v in self.params.values())
+                self.sample_ratio = sync_sample_ratio(
+                    self.bandwidth_mb_s, self.nservers, self.ngroups,
+                    size, per_step)
             batch = next(data_iter)
             step_rng = jax.random.fold_in(
                 jax.random.fold_in(rng, step), g)

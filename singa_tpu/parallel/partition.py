@@ -42,11 +42,11 @@ def param_shardings(mesh: Mesh, net: NeuralNet,
     """Per-param NamedSharding from ParamProto.partition_dim + the layer
     defaults (weights partition on the neuron dim under kLayerPartition,
     base_layer.h:121-128).  A param whose partition dim doesn't divide
-    the mesh axis replicates with a LOUD warning — a user asking for
-    tp=N on an indivisible width would otherwise silently get no
-    speedup and misattribute it."""
-    import sys
-
+    the mesh axis gets replicated STORAGE (jax.device_put only tiles
+    divisible dims) — its COMPUTE still partitions, via the in-step
+    uneven constraint NeuralNet._constrain_uneven_params emits (GSPMD
+    tiles with an implicit last-shard pad, the reference's
+    last-partition-remainder contract, neuralnet.cc:160-162)."""
     out = {}
     for name, spec in net.param_specs.items():
         axis = spec.mesh_axis or tp_axis
@@ -57,18 +57,8 @@ def param_shardings(mesh: Mesh, net: NeuralNet,
             axes[dim] = axis
             out[name] = NamedSharding(mesh, P(*axes))
         else:
-            key = (name, axis, n)
-            if n > 1 and dim >= 0 and key not in _replication_warned:
-                _replication_warned.add(key)
-                print(f"warning: param {name!r} dim {dim} (size "
-                      f"{spec.shape[dim]}) not divisible by mesh axis "
-                      f"{axis!r}={n}; REPLICATING instead of sharding",
-                      file=sys.stderr)
             out[name] = replicated(mesh)
     return out
-
-
-_replication_warned: set = set()
 
 
 def batch_shardings(mesh: Mesh, batch_tree: Any,

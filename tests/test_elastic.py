@@ -362,3 +362,65 @@ def test_distributed_replica_set_multiprocess_e2e(tmp_path, param_type,
         np.testing.assert_allclose(
             centers[0][k], np.asarray(center_sim[k]), rtol=1e-4,
             atol=1e-5)
+
+
+def test_configure_sync_sets_sample_ratio_deterministically():
+    """Runtime SyncConfig (param_manager.cc:85-93): crafted numbers give
+    an exact ratio, and a zero bandwidth (the TPU default pipe — ICI
+    collectives, not a modelled PS link) leaves sampling at 1.0."""
+    cfg = UpdaterConfig(type="kSGD", base_learning_rate=0.1,
+                        param_type="RandomSync", sync_frequency=1,
+                        warmup_steps=2)
+    ctl = ElasticController(cfg, ngroups=1, bandwidth_mb_s=0.3)
+    # throughput = 0.3 MB/s / 4 B = 75e3 floats/s; demand = 250e3/1s
+    ctl.configure_sync(1.0, 250_000, 1)
+    assert ctl.sample_ratio == pytest.approx(0.3)
+    off = ElasticController(cfg, ngroups=1, bandwidth_mb_s=0.0)
+    off.configure_sync(1.0, 250_000, 1)
+    assert off.sample_ratio == 1.0
+
+
+def test_configured_bandwidth_makes_the_exchange_sample():
+    """With a configured ratio < 1 the RandomSync exchange provably
+    SAMPLES: roughly that fraction of entries move, the rest stay."""
+    cfg = UpdaterConfig(type="kSGD", base_learning_rate=0.1,
+                        param_type="RandomSync", sync_frequency=1,
+                        warmup_steps=0)
+    ctl = ElasticController(cfg, ngroups=2, bandwidth_mb_s=0.3)
+    ctl.configure_sync(1.0, 250_000, 1)     # -> ratio 0.3
+    base = {"w": jnp.zeros(20_000, jnp.float32)}
+    ctl.init(base)
+    replica = {"w": jnp.ones(20_000, jnp.float32)}
+    # zero delta vs snapshot: the replica simply ADOPTS center values
+    # at the sampled mask, so the changed fraction IS the sample ratio
+    ctl.snapshot = {"w": jnp.ones(20_000, jnp.float32)}
+    out = ctl.maybe_sync(0, replica, rng=jax.random.PRNGKey(3))
+    changed = float((np.asarray(out["w"]) != 1.0).mean())
+    assert 0.25 < changed < 0.35, changed
+
+
+def test_replica_set_run_invokes_syncconfig_after_warmup():
+    """ReplicaSet.run must measure warmup step time and call SyncConfig
+    on every controller (worker.cc:42-48): a vanishing bandwidth yields
+    a near-zero sample ratio; the default (bandwidth off) stays 1.0."""
+    from singa_tpu.core.trainer import Trainer
+    from singa_tpu.data.synthetic import synthetic_image_batches
+    from singa_tpu.parallel.elastic import ReplicaSet
+
+    cfg = _mlp_cfg(moving_rate=0.0, sync_frequency=2, warmup=3, steps=0,
+                   param_type="RandomSync")
+    cfg.updater.momentum = 0.0
+    tr = Trainer(cfg, {"data": {"pixel": (28, 28), "label": ()}},
+                 log_fn=lambda s: None, donate=False)
+    rs = ReplicaSet(tr, ngroups=2, seed=0, bandwidth_mb_s=1e-9)
+    iters = [synthetic_image_batches(32, seed=11, stream_seed=70 + g)
+             for g in range(2)]
+    rs.run(iters, steps=6, seed=0)
+    assert all(c.sample_ratio < 0.01 for c in rs.controllers), \
+        [c.sample_ratio for c in rs.controllers]
+
+    rs_off = ReplicaSet(tr, ngroups=2, seed=0)
+    iters = [synthetic_image_batches(32, seed=11, stream_seed=80 + g)
+             for g in range(2)]
+    rs_off.run(iters, steps=6, seed=0)
+    assert all(c.sample_ratio == 1.0 for c in rs_off.controllers)
