@@ -271,3 +271,94 @@ def test_distributed_init_two_process_e2e(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc{i} failed:\n{out}"
         assert f"proc{i} global_sum=6.0" in out, out
+
+
+def test_multihost_sharded_checkpoint_save_restore(tmp_path):
+    """Multi-host sharded checkpointing (the scale story the reference's
+    split_threshold, model.proto:62-65, gestured at): two jax.distributed
+    processes save params sharded over a global 2x2 mesh through
+    CheckpointManager and restore them with the SAME shardings — each
+    process only ever materializes its addressable shards."""
+    import socket
+    import subprocess
+    import sys
+    import textwrap
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text(f"127.0.0.1:{port}\n127.0.0.1\n")
+    workspace = tmp_path / "ws"
+
+    child = tmp_path / "child.py"
+    child.write_text(textwrap.dedent("""
+        import sys
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from singa_tpu.parallel.bootstrap import distributed_init
+        from singa_tpu.utils.checkpoint import CheckpointManager
+
+        pid = int(sys.argv[1])
+        assert distributed_init(procs_id=pid, hostfile=sys.argv[2])
+        assert jax.process_count() == 2
+        devs = np.array(jax.devices()).reshape(2, 2)
+        mesh = Mesh(devs, ("data", "model"))
+
+        def make(shape, spec, seed):
+            vals = np.arange(np.prod(shape), dtype=np.float32
+                             ).reshape(shape) + seed
+            return jax.make_array_from_callback(
+                shape, NamedSharding(mesh, spec), lambda idx: vals[idx])
+
+        params = {"w": make((8, 4), P("data", "model"), 1),
+                  "b": make((4,), P("model"), 2)}
+        opt = {"momentum": {"w": make((8, 4), P("data", "model"), 3),
+                            "b": make((4,), P("model"), 4)}}
+        mgr = CheckpointManager(sys.argv[3])
+        mgr.save(5, params, opt)
+
+        template = {"params": params, "opt_state": opt}
+        rp, ro, step = mgr.restore(template=template)
+        assert step == 5
+        for k in params:
+            assert rp[k].sharding == params[k].sharding, (k, rp[k].sharding)
+            got = np.concatenate(
+                [np.asarray(s.data).ravel()
+                 for s in sorted(rp[k].addressable_shards,
+                                 key=lambda s: s.index)])
+            want = np.concatenate(
+                [np.asarray(s.data).ravel()
+                 for s in sorted(params[k].addressable_shards,
+                                 key=lambda s: s.index)])
+            np.testing.assert_array_equal(got, want)
+        assert ro["momentum"]["w"].sharding == opt["momentum"]["w"].sharding
+        print(f"proc{pid} sharded_ckpt_ok step={step}", flush=True)
+    """))
+
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2",
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    for var in ("JAX_NUM_PROCESSES", "JAX_PROCESS_ID",
+                "JAX_COORDINATOR_ADDRESS"):
+        env.pop(var, None)
+    procs = [subprocess.Popen(
+        [sys.executable, str(child), str(i), str(hostfile), str(workspace)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True) for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc{i} failed:\n{out}"
+        assert f"proc{i} sharded_ckpt_ok step=5" in out, out
