@@ -57,9 +57,14 @@ class Performance:
 
 @dataclass
 class TimerInfo:
-    """Per-phase wall-time accumulator (worker.h:91-114)."""
+    """Per-phase wall-time accumulator (worker.h:91-114).  The host
+    phases (data wait / device step) are timed directly; the device-side
+    fwd/bwd/update split the reference timed around each phase call is
+    one fused XLA program here, so it comes from a one-shot profiler
+    trace (Trainer.profile_phases) and rides along as `phase_shares`."""
     times: Dict[str, float] = field(default_factory=dict)
     steps: int = 0
+    phase_shares: Optional[Dict[str, float]] = None
 
     def add(self, phase: str, seconds: float) -> None:
         self.times[phase] = self.times.get(phase, 0.0) + seconds
@@ -69,7 +74,12 @@ class TimerInfo:
         parts = [f"{k}: {v / max(self.steps, 1) * 1e3:.2f}ms "
                  f"({100 * v / total:.0f}%)"
                  for k, v in self.times.items()]
-        return "Time per step — " + ", ".join(parts)
+        out = "Time per step — " + ", ".join(parts)
+        if self.phase_shares:
+            out += " [device: " + ", ".join(
+                f"{k} {100 * v:.0f}%"
+                for k, v in self.phase_shares.items()) + "]"
+        return out
 
     def reset(self) -> None:
         self.times.clear()
@@ -359,6 +369,38 @@ class Trainer:
         self.debug_step = (jax.jit(debug_step, compiler_options=copts)
                            if self.cfg.debug else None)
 
+    def profile_phases(self, params, opt_state, batch, step: int = 0,
+                       rng=None, iters: int = 2,
+                       outdir: Optional[str] = None) -> Dict[str, float]:
+        """Measure the device-side fwd/bwd/update split of the train
+        step (worker.h:91-114's tForward_/tBackward_/tSyncParam_ report)
+        and pin it on `self.timer` for every subsequent TimerInfo line.
+
+        One-shot cost: an AOT lower+compile of the scan step (for the
+        HLO metadata) plus a short traced run.  Training state is not
+        consumed — donated buffers are fed copies."""
+        import tempfile
+
+        from ..utils import profiler
+
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        outdir = outdir or tempfile.mkdtemp(prefix="singa_phase_prof_")
+        args = (params, opt_state, batch, step, rng, iters)
+        txt = self.train_steps.lower(*args).compile().as_text()
+        # the jitted scan may donate params/opt_state — hand it copies
+        cp = jax.tree_util.tree_map(jnp.copy, params)
+        co = jax.tree_util.tree_map(jnp.copy, opt_state)
+        p, _, _ = self.train_steps(cp, co, batch, step, rng, iters)
+        profiler.hard_sync(p)   # compile path warm before the trace
+        with profiler.trace(outdir):
+            cp = jax.tree_util.tree_map(jnp.copy, params)
+            co = jax.tree_util.tree_map(jnp.copy, opt_state)
+            p, _, _ = self.train_steps(cp, co, batch, step, rng, iters)
+            profiler.hard_sync(p)
+        shares = profiler.phase_shares(outdir, txt)
+        self.timer.phase_shares = shares
+        return shares
+
     # -- init --------------------------------------------------------------
     def init(self, seed: int = 0):
         rng = jax.random.PRNGKey(seed)
@@ -525,6 +567,21 @@ class Trainer:
                     for h in hooks:
                         h(s, m)
                 if self.display_now(s):
+                    if (self.timer.phase_shares is None
+                            and (getattr(self, "phase_profile", False)
+                                 or os.environ.get(
+                                     "SINGA_TPU_PHASE_PROFILE") == "1")):
+                        # one-shot device fwd/bwd/update attribution;
+                        # never let a profiler hiccup kill training
+                        try:
+                            self.profile_phases(
+                                params, opt_state,
+                                batch if n == 1 else batches[-1],
+                                step=step, rng=rng)
+                        except Exception as e:  # pragma: no cover
+                            self.timer.phase_shares = {}
+                            self.log(f"warning: phase profile failed: "
+                                     f"{e}")
                     self.log(f"step-{s}: {self.perf.to_string()}")
                     self.log(self.timer.to_string())
                     self.perf.reset()

@@ -48,6 +48,10 @@ def make_argparser() -> argparse.ArgumentParser:
                     help="run up to N steps per device dispatch (fused "
                          "lax.scan inner loop; cadence events still fire "
                          "at their exact steps)")
+    ap.add_argument("--phase_profile", action="store_true",
+                    help="measure the device fwd/bwd/update split once "
+                         "(profiler trace) and report it at every "
+                         "display interval (worker.h:91-114 parity)")
     return ap
 
 
@@ -104,6 +108,7 @@ def main(argv=None) -> int:
                       n_micro=(cluster.pipeline_microbatches
                                if cluster else 0),
                       ngroups=ngroups)
+    trainer.phase_profile = args.phase_profile
 
     from .parallel.elastic import async_active
     async_multi = ngroups > 1 and async_active(model.updater)

@@ -1,0 +1,66 @@
+"""Device-side fwd/bwd/update phase report (worker.h:91-114 parity):
+the reference timed each phase around its call; here the split comes
+from a one-shot profiler trace attributed through HLO metadata and then
+rides every TimerInfo display line."""
+
+import jax
+import numpy as np
+
+from singa_tpu.config.schema import model_config_from_dict
+from singa_tpu.core.trainer import Trainer
+from singa_tpu.data.synthetic import synthetic_image_batches
+from singa_tpu.utils.profiler import classify_phase
+
+
+def _cfg():
+    return model_config_from_dict({
+        "name": "m", "train_steps": 6, "display_frequency": 2,
+        "updater": {"type": "kSGD", "base_learning_rate": 0.1,
+                    "learning_rate_change_method": "kFixed"},
+        "neuralnet": {"layer": [
+            {"name": "data", "type": "kShardData",
+             "data_param": {"batchsize": 8}},
+            {"name": "mnist", "type": "kMnistImage", "srclayers": "data"},
+            {"name": "label", "type": "kLabel", "srclayers": "data"},
+            {"name": "ip", "type": "kInnerProduct", "srclayers": "mnist",
+             "inner_product_param": {"num_output": 10},
+             "param": [{"name": "weight"}, {"name": "bias"}]},
+            {"name": "loss", "type": "kSoftmaxLoss",
+             "srclayers": ["ip", "label"]}]}})
+
+
+def test_classify_phase_tags():
+    assert classify_phase(
+        "jit(f)/jvp(net)/dot_general  [linear.py:10]") == "fwd"
+    assert classify_phase(
+        "jit(f)/transpose(jvp(net))/dot_general  [linear.py:10]") == "bwd"
+    assert classify_phase(
+        "jit(f)/while/body/mul  [updater.py:150]") == "update"
+
+
+def test_run_reports_device_phase_shares(tmp_path):
+    logs = []
+    tr = Trainer(_cfg(), {"data": {"pixel": (28, 28), "label": ()}},
+                 donate=False, log_fn=logs.append)
+    tr.phase_profile = True
+    p, o = tr.init(0)
+    tr.run(p, o, synthetic_image_batches(8))
+    shares = tr.timer.phase_shares
+    assert shares is not None and shares, shares
+    assert 0 < shares["bwd"] < 1 and 0 < shares["fwd"] < 1
+    assert abs(sum(shares.values()) - 1.0) < 1e-6
+    timer_lines = [l for l in logs if "Time per step" in l]
+    assert timer_lines and all("[device: fwd" in l for l in timer_lines)
+
+
+def test_profile_phases_preserves_training_state():
+    """profile_phases must not consume donated buffers: params passed in
+    stay usable afterwards."""
+    tr = Trainer(_cfg(), {"data": {"pixel": (28, 28), "label": ()}},
+                 donate=True, log_fn=lambda s: None)
+    p, o = tr.init(0)
+    batch = next(synthetic_image_batches(8))
+    tr.profile_phases(p, o, batch)
+    # state still alive: a real step runs on the same arrays
+    p2, o2, m = tr.train_step(p, o, batch, 0, jax.random.PRNGKey(0))
+    assert np.isfinite(float(m["loss"]))

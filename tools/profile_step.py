@@ -14,10 +14,6 @@ leading source annotation when present.
 from __future__ import annotations
 
 import argparse
-import collections
-import glob
-import gzip
-import json
 import os
 import sys
 
@@ -89,51 +85,23 @@ def attribute(trainer, params, opt_state, batch_d, iters):
     """Map HLO op names -> (source_file:line, op_name metadata) from the
     compiled train_steps text, so trace fusion names become readable."""
     import jax
-    import re
+
+    from singa_tpu.utils.profiler import hlo_attribution
 
     key = jax.random.PRNGKey(0)
     txt = trainer.train_steps.lower(
         params, opt_state, batch_d, 0, key, iters).compile().as_text()
-    attr = {}
-    for m in re.finditer(
-            r"%?([\w.\-]+) = [^\n]*metadata={([^}]*)}", txt):
-        name, meta = m.group(1), m.group(2)
-        op = re.search(r'op_name="([^"]*)"', meta)
-        src = re.search(r'source_file="([^"]*)"', meta)
-        line = re.search(r"source_line=(\d+)", meta)
-        tag = ""
-        if op:
-            tag = op.group(1)
-        if src:
-            tag += f"  [{os.path.basename(src.group(1))}:"
-            tag += f"{line.group(1) if line else '?'}]"
-        if tag:
-            attr[name] = tag
-    return attr
+    return hlo_attribution(txt)
 
 
 def parse(outdir, iters, top, attr=None):
-    paths = glob.glob(os.path.join(
-        outdir, "plugins/profile/*/*.trace.json.gz"))
-    if not paths:
-        raise SystemExit(f"no trace under {outdir}")
-    path = max(paths, key=os.path.getmtime)
-    with gzip.open(path, "rt") as f:
-        trace = json.load(f)
-    events = trace["traceEvents"]
-    pid_names = {e["pid"]: e["args"]["name"] for e in events
-                 if e.get("ph") == "M" and e.get("name") == "process_name"
-                 and "args" in e}
-    tpu_pids = {p for p, n in pid_names.items()
-                if "TPU" in n or "/device" in n.lower()}
-    per_op = collections.Counter()
-    for e in events:
-        if e.get("ph") != "X" or e.get("pid") not in tpu_pids:
-            continue
-        name = e.get("name", "?")
-        per_op[name] += e.get("dur", 0)
-    total_us = sum(per_op.values())
-    print(f"# trace {path}")
+    from singa_tpu.utils.profiler import parse_trace_ops
+
+    try:
+        per_op, total_us = parse_trace_ops(outdir)
+    except FileNotFoundError as e:
+        raise SystemExit(str(e))
+    print(f"# trace {outdir}")
     print(f"# total device time {total_us / 1e3 / iters:.2f} ms/step over "
           f"{iters} iters, {len(per_op)} distinct ops")
     print(f"{'ms/step':>9s}  {'%':>5s}  op")
