@@ -354,6 +354,33 @@ class Trainer:
         self.test_step = make_eval(self.test_net) if self.test_net else None
         self.val_step = make_eval(self.val_net) if self.val_net else None
 
+        def make_eval_scan(net):
+            apply_fn = self._net_apply(net)
+
+            def eval_scan(params, batches):
+                """Stacked eval batches → stacked metrics in ONE
+                compiled program — the eval counterpart of train_scan
+                (a tunneled chip pays ~30ms per dispatch; a 100-step
+                eval cadence was paying it 100 times)."""
+                def body(carry, batch):
+                    _, metrics, _ = apply_fn(params, batch, train=False,
+                                             mesh=mesh,
+                                             compute_dtype=cdtype)
+                    return carry, metrics
+                _, ms = jax.lax.scan(body, None, batches)
+                return ms
+            return jax.jit(eval_scan, compiler_options=copts)
+
+        # evaluate() looks the fused variant up by the step_fn handed to
+        # it, so external callers passing custom fns keep per-batch eval
+        self._eval_scans = {}
+        if self.test_step is not None:
+            self._eval_scans[id(self.test_step)] = \
+                make_eval_scan(self.test_net)
+        if self.val_step is not None:
+            self._eval_scans[id(self.val_step)] = \
+                make_eval_scan(self.val_net)
+
         def debug_step(params, batch, step, rng):
             """Per-layer activations + param grads for DebugInfo
             (neuralnet.cc:350-378 prints data AND grad norms)."""
@@ -426,9 +453,28 @@ class Trainer:
 
     # -- loops -------------------------------------------------------------
     def evaluate(self, params, data_iter: Iterator, steps: int,
-                 step_fn) -> Dict[str, float]:
+                 step_fn, scan_chunk: int = 25) -> Dict[str, float]:
+        """Average metrics over `steps` eval batches.  When `step_fn` is
+        one of the trainer's own eval steps, full chunks of `scan_chunk`
+        batches run as ONE fused lax.scan dispatch (same amortization as
+        the train loop's scan_chunk); the remainder and custom step_fns
+        dispatch per batch."""
         perf = Performance()
-        for _ in range(max(steps, 1)):
+        steps = max(steps, 1)
+        scan_fn = getattr(self, "_eval_scans", {}).get(id(step_fn))
+        done = 0
+        chunk = min(steps, max(scan_chunk, 1))
+        if scan_fn is not None and chunk > 1:
+            while steps - done >= chunk:
+                batches = [next(data_iter) for _ in range(chunk)]
+                stacked = jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+                    *batches)
+                ms = jax.device_get(scan_fn(params, stacked))
+                for i in range(chunk):
+                    perf.update({k: v[i] for k, v in ms.items()})
+                done += chunk
+        for _ in range(steps - done):
             batch = next(data_iter)
             perf.update(jax.device_get(step_fn(params, batch)))
         return perf.averages()

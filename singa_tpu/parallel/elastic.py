@@ -211,8 +211,13 @@ class ReplicaSet:
             self.replicas.append({"params": p, "opt": o})
 
     def _share_center(self, src: ElasticController) -> None:
+        # one LOGICAL center, but fresh containers per controller:
+        # leaves are immutable jax arrays (safe to share), while an
+        # accidental in-place dict mutation on one controller must not
+        # silently corrupt every replica's view.  Snapshots stay
+        # per-replica.
         for c in self.controllers:
-            c.center = src.center   # snapshots stay per-replica
+            c.center = jax.tree_util.tree_map(lambda x: x, src.center)
 
     def run(self, data_iters, steps: int, seed: int = 0,
             hooks=None):

@@ -64,3 +64,22 @@ def test_profile_phases_preserves_training_state():
     # state still alive: a real step runs on the same arrays
     p2, o2, m = tr.train_step(p, o, batch, 0, jax.random.PRNGKey(0))
     assert np.isfinite(float(m["loss"]))
+
+
+def test_fused_eval_scan_matches_per_batch():
+    """evaluate() fuses full chunks into one lax.scan dispatch; the
+    averaged metrics must equal the per-batch path on the same stream."""
+    from singa_tpu.config import load_model_config
+
+    cfg = load_model_config("examples/mnist/conv.conf")
+    cfg.train_steps = 1
+    tr = Trainer(cfg, {"data": {"pixel": (28, 28), "label": ()}},
+                 donate=False, log_fn=lambda s: None)
+    assert tr.test_step is not None
+    p, _ = tr.init(0)
+    mk = lambda: synthetic_image_batches(16, seed=5, stream_seed=9)
+    a = tr.evaluate(p, mk(), 30, tr.test_step)            # 25-scan + 5
+    b = tr.evaluate(p, mk(), 30, tr.test_step, scan_chunk=1)
+    assert set(a) == set(b)
+    for k in a:
+        assert abs(a[k] - b[k]) < 1e-5, (k, a[k], b[k])
