@@ -8,6 +8,9 @@ Modes (same surface):
           data_source.h:63-148: cv2 resize, CHW uint8)
   split:  re-partition a shard into N sub-shards (Split/SplitN,
           data_loader.cc:43-94)
+  partition: per-worker dataset placement for multi-host training —
+          script/load_data.py's partition(): group-sliced, replicated
+          or split inside each group, one proc{i}/ shard per worker
   mean:   compute the per-pixel float mean of a shard and write it as a
           single Record (the reference's mean.binaryproto role)
   convert-lmdb: walk a caffe LMDB environment of Datum values
@@ -19,6 +22,7 @@ Usage:
   python -m singa_tpu.tools.loader create cifar10 <data_batch.bin...> <out_folder>
   python -m singa_tpu.tools.loader create imagefolder <img_dir> <list_file> <out_folder> [size]
   python -m singa_tpu.tools.loader split <in_folder> <out_prefix> <n>
+  python -m singa_tpu.tools.loader partition <in_folder> <out_root> <nworkers> [group_size] [--replicate] [--shuffle[=seed]]
   python -m singa_tpu.tools.loader mean <shard_folder> <out_file>
   python -m singa_tpu.tools.loader convert-lmdb <lmdb_env> <out_folder>
 """
@@ -162,6 +166,54 @@ def split_shard(in_folder: str, out_prefix: str, n: int) -> List[int]:
     return counts
 
 
+def partition_shard(in_folder: str, out_root: str, nworkers: int,
+                    group_size: int = 1, replicate: bool = False,
+                    shuffle_seed: int | None = None) -> List[int]:
+    """Per-worker dataset placement — script/load_data.py's partition()
+    as a shard operation (the reference slices a record-id list per
+    worker group, then either replicates the slice inside the group or
+    splits it per worker, and scps each list to its host).
+
+    Writes `out_root/proc{i}/` for i in [0, nworkers): worker i (process
+    i in the -procsID/-hostfile launch) gets group g = i // group_size's
+    contiguous slice of the source records — the whole slice when
+    `replicate` (every group member sees the group's data; intra-group
+    parallelism splits the batch, not the dataset), else its contiguous
+    sub-slice.  Placement on the actual hosts is one rsync of proc{i}/
+    per host (the ssh/scp loop has no meaning in this zero-egress
+    image).  Returns per-worker record counts."""
+    if nworkers <= 0 or group_size <= 0 or nworkers % group_size:
+        raise ValueError(f"nworkers {nworkers} must be a positive "
+                         f"multiple of group_size {group_size}")
+    with Shard(in_folder, Shard.KREAD) as src:
+        records = list(src)
+    if shuffle_seed is not None:
+        np.random.default_rng(shuffle_seed).shuffle(records)
+    ngroups = nworkers // group_size
+    per_group = len(records) // ngroups
+    counts = []
+    for i in range(nworkers):
+        g, k = divmod(i, group_size)
+        # the last group absorbs the remainder (the reference's integer
+        # division silently DROPPED the tail; records are too expensive
+        # to lose on purpose)
+        g_end = (g + 1) * per_group if g < ngroups - 1 else len(records)
+        grp = records[g * per_group:g_end]
+        if replicate:
+            mine = grp
+        else:
+            per_w = len(grp) // group_size
+            w_end = ((k + 1) * per_w if k < group_size - 1 else len(grp))
+            mine = grp[k * per_w:w_end]
+        folder = os.path.join(out_root, f"proc{i}")
+        os.makedirs(folder, exist_ok=True)
+        with Shard(folder, Shard.KCREATE) as out:
+            for key, val in mine:
+                out.insert(key, val)
+        counts.append(len(mine))
+    return counts
+
+
 def main(argv=None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     if not argv:
@@ -189,6 +241,20 @@ def main(argv=None) -> int:
         in_folder, out_prefix, n = argv[1], argv[2], int(argv[3])
         counts = split_shard(in_folder, out_prefix, n)
         print(f"split into {counts}")
+    elif cmd == "partition":
+        flags = [a for a in argv[1:] if a.startswith("--")]
+        pos = [a for a in argv[1:] if not a.startswith("--")]
+        in_folder, out_root, nworkers = pos[0], pos[1], int(pos[2])
+        gsize = int(pos[3]) if len(pos) > 3 else 1
+        seed = None
+        for f in flags:
+            if f.startswith("--shuffle"):
+                seed = int(f.split("=")[1]) if "=" in f else 0
+        counts = partition_shard(in_folder, out_root, nworkers, gsize,
+                                 replicate="--replicate" in flags,
+                                 shuffle_seed=seed)
+        print(f"partitioned into {counts} (proc0..proc{nworkers - 1} "
+              f"under {out_root})")
     elif cmd == "mean":
         shard_folder, out_path = argv[1], argv[2]
         mean = compute_mean(shard_folder, out_path)

@@ -359,3 +359,43 @@ def test_pipeline_skips_imageless_records(tmp_path):
     batches = list(shard_batches(str(folder), 2, loop=False))
     sizes = [b["data"]["pixel"].shape[0] for b in batches]
     assert sum(sizes) == n and all(s == 2 for s in sizes[:-1])
+
+
+def test_partition_shard_group_semantics(tmp_path):
+    """script/load_data.py partition() parity: workers in one group see
+    that group's contiguous slice — the whole slice when replicated,
+    disjoint sub-slices otherwise; the tail is never dropped."""
+    from singa_tpu.data.records import Record, SingleLabelImageRecord
+    from singa_tpu.data.shard import Shard
+    from singa_tpu.tools.loader import partition_shard
+
+    src = tmp_path / "src"
+    src.mkdir()
+    with Shard(str(src), Shard.KCREATE) as sh:
+        for i in range(23):   # deliberately not divisible by 2 or 4
+            rec = Record(image=SingleLabelImageRecord(
+                shape=[2, 2], label=i, pixel=bytes([i] * 4)))
+            sh.insert(f"k{i:03d}", rec.encode())
+
+    def labels(folder):
+        with Shard(folder, Shard.KREAD) as sh:
+            return [Record.decode(v).image.label for _, v in sh]
+
+    # 4 workers, groups of 2, split inside the group
+    counts = partition_shard(str(src), str(tmp_path / "split"), 4, 2)
+    got = [labels(str(tmp_path / "split" / f"proc{i}")) for i in range(4)]
+    assert counts == [len(g) for g in got]
+    # group 0 = records [0, 11), group 1 = [11, 23); disjoint per worker
+    assert got[0] + got[1] == list(range(11))
+    assert got[2] + got[3] == list(range(11, 23))
+    assert sum(counts) == 23   # nothing dropped
+
+    # replicate: every group member sees the full group slice
+    partition_shard(str(src), str(tmp_path / "rep"), 4, 2,
+                    replicate=True)
+    r = [labels(str(tmp_path / "rep" / f"proc{i}")) for i in range(4)]
+    assert r[0] == r[1] == list(range(11))
+    assert r[2] == r[3] == list(range(11, 23))
+
+    with pytest.raises(ValueError):
+        partition_shard(str(src), str(tmp_path / "bad"), 4, 3)
