@@ -215,7 +215,10 @@ class AttentionLayer(Layer):
             if self.use_rope:
                 q = rope_packed(q, positions, self.heads, self.rope_theta)
                 k = rope_packed(k, positions, self.heads, self.rope_theta)
-            out = flash_attention_packed(q, k, v, self.heads, self.causal)
+            from ..ops.attention import flash_blocks
+            bq, bk = flash_blocks(s)
+            out = flash_attention_packed(q, k, v, self.heads, self.causal,
+                                         bq, bk)
             return self._proj(params, self.wo, out.astype(x.dtype), ctx)
         q, k, v = self.qkv(params, x, jnp.arange(s), ctx)
         k = expand_kv_heads(k, self.heads)
@@ -228,7 +231,8 @@ class AttentionLayer(Layer):
             from ..parallel.sequence import ulysses_attention
             out = ulysses_attention(q, k, v, ctx.mesh, "seq", self.causal)
         elif s % 128 == 0 and self.head_dim % 8 == 0:
-            out = flash_attention(q, k, v, self.causal)
+            from ..ops.attention import flash_blocks
+            out = flash_attention(q, k, v, self.causal, *flash_blocks(s))
         else:
             # once-keyed on (name, shape): a second model reusing a
             # layer name at a different geometry still warns

@@ -29,6 +29,35 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+# Tuned flash block geometry.  (512, 512) won the S=1024 sweep
+# (BASELINE.md "Explored and rejected": 1024-blocks crash the packed
+# compile, strided 1024 ties but pays transposes); the long-S rows come
+# from tools/longctx_sweep.py.  `set_flash_blocks` pins an override for
+# in-process A/B sweeps.
+_FLASH_BLOCK_OVERRIDE: Optional[tuple] = None
+
+# Causal kernels CAN compile two compute bodies: fully-visible blocks
+# (no mask select) and diagonal-partial ones.  Measured on v5e at
+# S=4096 (tools/longctx_sweep.py, in-process A/B): the split is a wash
+# at 512x512 (-0.3%, noise) and a 55% REGRESSION at 512x1024 (536 vs
+# 347 ms/step) — the duplicated body defeats Mosaic's pipelining — so
+# it stays off; kept A/B-able for future geometries.
+MASK_SPLIT = False
+
+
+def set_flash_blocks(override: Optional[tuple]) -> None:
+    """Override (block_q, block_k) globally (None = tuned table).
+    Takes effect on the next trace — re-jit after changing."""
+    global _FLASH_BLOCK_OVERRIDE
+    _FLASH_BLOCK_OVERRIDE = override
+
+
+def flash_blocks(seq_len: int) -> tuple:
+    """Tuned (block_q, block_k) for a sequence length."""
+    if _FLASH_BLOCK_OVERRIDE is not None:
+        return _FLASH_BLOCK_OVERRIDE
+    return (512, 512)
+
 
 # ---------------------------------------------------------------------------
 # reference attention (oracle + backward path)
@@ -291,12 +320,23 @@ def _packed_params(interpret):
                 dimension_semantics=("parallel", "parallel", "arbitrary")))
 
 
+LOG2E = 1.4426950408889634
+
+
 def _packed_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
                        acc_ref, *, heads, causal, scale, bq, bk):
     """All-heads blocks: refs are (1, bq|bk, H·D); the head loop runs
     in-kernel over D-column slices (Mosaic rejects last-dim blocks
     narrower than a lane tile, so per-head blocks of D=64 are not an
-    option — the full H·D width equals the array dim, which is)."""
+    option — the full H·D width equals the array dim, which is).
+
+    VPU economy (the co-bottleneck at D=64, where exp work per score is
+    within ~2x of MXU work): scores live in the base-2 domain — the
+    softmax scale and log2(e) fold into the q load (one mult per q
+    element instead of per score, exp → native exp2) — and causal
+    blocks split into fully-visible (no mask select at all; the vast
+    majority at long S) vs diagonal-partial (masked).  m/l trackers are
+    base-2; the stored lse converts back to natural once at finalize."""
     iq = pl.program_id(1)
     ik = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -308,11 +348,11 @@ def _packed_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    def compute():
-        mask = (_causal_mask_block(iq, ik, bq, bk) if causal else None)
+    def compute(masked):
+        mask = (_causal_mask_block(iq, ik, bq, bk) if masked else None)
         for h in range(heads):
             sl = slice(h * d, (h + 1) * d)
-            q = q_ref[0, :, sl].astype(jnp.float32) * scale
+            q = q_ref[0, :, sl].astype(jnp.float32) * (scale * LOG2E)
             k = k_ref[0, :, sl].astype(jnp.float32)
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
@@ -320,8 +360,8 @@ def _packed_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
                 s = jnp.where(mask, s, NEG_INF)
             m_prev = m_ref[:, h:h + 1]
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-            p = jnp.exp(s - m_new)
-            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp2(s - m_new)
+            alpha = jnp.exp2(m_prev - m_new)
             l_ref[:, h:h + 1] = (l_ref[:, h:h + 1] * alpha
                                  + jnp.sum(p, axis=1, keepdims=True))
             acc_ref[:, sl] = acc_ref[:, sl] * alpha + jax.lax.dot_general(
@@ -330,17 +370,29 @@ def _packed_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
                 preferred_element_type=jnp.float32)
             m_ref[:, h:h + 1] = m_new
 
-    if causal:
+    if causal and MASK_SPLIT:
+        # fully-visible blocks (max kpos <= min qpos) skip the mask
+        full = (ik + 1) * bk - 1 <= iq * bq
+
+        @pl.when(full)
+        def _():
+            compute(False)
+
+        @pl.when(jnp.logical_not(full) & (ik * bk <= (iq + 1) * bq - 1))
+        def _():
+            compute(True)
+    elif causal:
         @pl.when(ik * bk <= (iq + 1) * bq - 1)
         def _():
-            compute()
+            compute(True)
     else:
-        compute()
+        compute(False)
 
     @pl.when(ik == nk - 1)
     def _finalize():
         l_safe = jnp.maximum(l_ref[...], 1e-30)
-        lse_ref[0] = m_ref[...] + jnp.log(l_safe)
+        # natural-log lse: m is base-2, l is linear
+        lse_ref[0] = m_ref[...] * (1.0 / LOG2E) + jnp.log(l_safe)
         for h in range(heads):
             sl = slice(h * d, (h + 1) * d)
             o_ref[0, :, sl] = (acc_ref[:, sl]
@@ -358,17 +410,17 @@ def _packed_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    def compute():
-        mask = (_causal_mask_block(iq, ik, bq, bk) if causal else None)
+    def compute(masked):
+        mask = (_causal_mask_block(iq, ik, bq, bk) if masked else None)
         for h in range(heads):
             sl = slice(h * d, (h + 1) * d)
-            q = q_ref[0, :, sl].astype(jnp.float32) * scale
+            q = q_ref[0, :, sl].astype(jnp.float32) * (scale * LOG2E)
             k = k_ref[0, :, sl].astype(jnp.float32)
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
             if mask is not None:
                 s = jnp.where(mask, s, NEG_INF)
-            p = jnp.exp(s - lse_ref[0, :, h:h + 1])
+            p = jnp.exp2(s - lse_ref[0, :, h:h + 1] * LOG2E)
             do = do_ref[0, :, sl].astype(jnp.float32)
             dp = jax.lax.dot_general(
                 do, v_ref[0, :, sl].astype(jnp.float32),
@@ -380,12 +432,22 @@ def _packed_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
                 (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
-    if causal:
+    if causal and MASK_SPLIT:
+        full = (ik + 1) * bk - 1 <= iq * bq
+
+        @pl.when(full)
+        def _():
+            compute(False)
+
+        @pl.when(jnp.logical_not(full) & (ik * bk <= (iq + 1) * bq - 1))
+        def _():
+            compute(True)
+    elif causal:
         @pl.when(ik * bk <= (iq + 1) * bq - 1)
         def _():
-            compute()
+            compute(True)
     else:
-        compute()
+        compute(False)
 
     @pl.when(ik == nk - 1)
     def _done():
@@ -405,17 +467,17 @@ def _packed_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    def compute():
-        mask = (_causal_mask_block(iq, ik, bq, bk) if causal else None)
+    def compute(masked):
+        mask = (_causal_mask_block(iq, ik, bq, bk) if masked else None)
         for h in range(heads):
             sl = slice(h * d, (h + 1) * d)
-            q = q_ref[0, :, sl].astype(jnp.float32) * scale
+            q = q_ref[0, :, sl].astype(jnp.float32) * (scale * LOG2E)
             k = k_ref[0, :, sl].astype(jnp.float32)
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
             if mask is not None:
                 s = jnp.where(mask, s, NEG_INF)
-            p = jnp.exp(s - lse_ref[0, :, h:h + 1])
+            p = jnp.exp2(s - lse_ref[0, :, h:h + 1] * LOG2E)
             do = do_ref[0, :, sl].astype(jnp.float32)
             dv_acc[:, sl] = dv_acc[:, sl] + jax.lax.dot_general(
                 p.astype(do_ref.dtype), do_ref[0, :, sl],
@@ -431,12 +493,22 @@ def _packed_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
                 (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
-    if causal:
+    if causal and MASK_SPLIT:
+        full = (ik + 1) * bk - 1 <= iq * bq
+
+        @pl.when(full)
+        def _():
+            compute(False)
+
+        @pl.when(jnp.logical_not(full) & (ik * bk <= (iq + 1) * bq - 1))
+        def _():
+            compute(True)
+    elif causal:
         @pl.when(ik * bk <= (iq + 1) * bq - 1)
         def _():
-            compute()
+            compute(True)
     else:
-        compute()
+        compute(False)
 
     @pl.when(iq == nq - 1)
     def _done():

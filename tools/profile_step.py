@@ -44,14 +44,13 @@ def build_alexnet(batch):
     return trainer, params, opt_state, batch_d
 
 
-def build_transformer(batch):
+def build_transformer(batch, seq_len=1024):
     import jax
 
     from singa_tpu.core.trainer import Trainer
     from singa_tpu.models.transformer import (synthetic_token_batches,
                                               transformer_lm)
 
-    seq_len = 1024
     cfg = transformer_lm(vocab_size=32768, num_layers=12, embed_dim=768,
                          num_heads=12, head_dim=64, seq_len=seq_len,
                          batchsize=batch)
@@ -119,11 +118,14 @@ def main():
     ap.add_argument("--iters", type=int, default=3)
     ap.add_argument("--top", type=int, default=40)
     ap.add_argument("--outdir", default="/tmp/prof_step")
+    ap.add_argument("--seq", type=int, default=1024,
+                    help="transformer sequence length")
     args = ap.parse_args()
     if args.model == "alexnet":
         built = build_alexnet(args.batch or 8192)
     else:
-        built = build_transformer(args.batch or 8)
+        built = build_transformer(args.batch or max(8192 // args.seq, 1),
+                                  args.seq)
     trainer, params, opt_state, batch_d = built
     attr = attribute(trainer, params, opt_state, batch_d, args.iters)
     capture(*built, args.iters, args.outdir)
