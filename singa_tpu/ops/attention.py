@@ -53,9 +53,18 @@ def set_flash_blocks(override: Optional[tuple]) -> None:
 
 
 def flash_blocks(seq_len: int) -> tuple:
-    """Tuned (block_q, block_k) for a sequence length."""
+    """Tuned (block_q, block_k) for a sequence length.
+
+    v5e, in-process in-net A/B (tools/longctx_sweep.py, round 4):
+    bk=1024 wins at every S >= 1024 — the fatter KV block halves the
+    per-block VPU overhead passes (rescale/max bookkeeping) per score —
+    by +1.1% (S=1024), +10% (S=4096), +12% (S=8192) over 512x512.
+    bq=1024+, bk=2048 crash the Mosaic compile at any scoped-vmem
+    budget; bq=256 loses 3-13% everywhere."""
     if _FLASH_BLOCK_OVERRIDE is not None:
         return _FLASH_BLOCK_OVERRIDE
+    if seq_len >= 1024:
+        return (512, 1024)
     return (512, 512)
 
 
@@ -352,8 +361,12 @@ def _packed_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
         mask = (_causal_mask_block(iq, ik, bq, bk) if masked else None)
         for h in range(heads):
             sl = slice(h * d, (h + 1) * d)
-            q = q_ref[0, :, sl].astype(jnp.float32) * (scale * LOG2E)
-            k = k_ref[0, :, sl].astype(jnp.float32)
+            # operands stay in their input dtype: bf16 x bf16 -> f32
+            # runs the MXU at full rate (an f32 upcast halves it); the
+            # base-2 scale folds into q in that dtype, flash-standard
+            q = q_ref[0, :, sl] * jnp.asarray(scale * LOG2E,
+                                              q_ref.dtype)
+            k = k_ref[0, :, sl]
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
             if mask is not None:
@@ -414,16 +427,19 @@ def _packed_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
         mask = (_causal_mask_block(iq, ik, bq, bk) if masked else None)
         for h in range(heads):
             sl = slice(h * d, (h + 1) * d)
-            q = q_ref[0, :, sl].astype(jnp.float32) * (scale * LOG2E)
-            k = k_ref[0, :, sl].astype(jnp.float32)
+            # operands stay in their input dtype: bf16 x bf16 -> f32
+            # runs the MXU at full rate (an f32 upcast halves it); the
+            # base-2 scale folds into q in that dtype, flash-standard
+            q = q_ref[0, :, sl] * jnp.asarray(scale * LOG2E,
+                                              q_ref.dtype)
+            k = k_ref[0, :, sl]
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
             if mask is not None:
                 s = jnp.where(mask, s, NEG_INF)
             p = jnp.exp2(s - lse_ref[0, :, h:h + 1] * LOG2E)
-            do = do_ref[0, :, sl].astype(jnp.float32)
             dp = jax.lax.dot_general(
-                do, v_ref[0, :, sl].astype(jnp.float32),
+                do_ref[0, :, sl], v_ref[0, :, sl],
                 (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
             ds = p * (dp - dl_ref[0, :, h:h + 1])
@@ -471,20 +487,23 @@ def _packed_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
         mask = (_causal_mask_block(iq, ik, bq, bk) if masked else None)
         for h in range(heads):
             sl = slice(h * d, (h + 1) * d)
-            q = q_ref[0, :, sl].astype(jnp.float32) * (scale * LOG2E)
-            k = k_ref[0, :, sl].astype(jnp.float32)
+            # operands stay in their input dtype: bf16 x bf16 -> f32
+            # runs the MXU at full rate (an f32 upcast halves it); the
+            # base-2 scale folds into q in that dtype, flash-standard
+            q = q_ref[0, :, sl] * jnp.asarray(scale * LOG2E,
+                                              q_ref.dtype)
+            k = k_ref[0, :, sl]
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
             if mask is not None:
                 s = jnp.where(mask, s, NEG_INF)
             p = jnp.exp2(s - lse_ref[0, :, h:h + 1] * LOG2E)
-            do = do_ref[0, :, sl].astype(jnp.float32)
             dv_acc[:, sl] = dv_acc[:, sl] + jax.lax.dot_general(
                 p.astype(do_ref.dtype), do_ref[0, :, sl],
                 (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
             dp = jax.lax.dot_general(
-                do, v_ref[0, :, sl].astype(jnp.float32),
+                do_ref[0, :, sl], v_ref[0, :, sl],
                 (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
             ds = p * (dp - dl_ref[0, :, h:h + 1])
