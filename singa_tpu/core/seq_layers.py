@@ -191,11 +191,13 @@ class AttentionLayer(Layer):
 
     def _packed_eligible(self, s: int, ctx) -> bool:
         """The zero-transpose packed flash path: single-device attention
-        with full (non-GQA) heads on flash-legal shapes.  Mesh runs keep
-        the strided path so GSPMD sees the same operand structure as
-        before (head-sharded custom calls are propagation-sensitive)."""
+        on flash-legal shapes, GQA included (the kernels read each q
+        head's group kv slice in-kernel — no expand_kv_heads copies).
+        Mesh runs keep the strided path so GSPMD sees the same operand
+        structure as before (head-sharded custom calls are
+        propagation-sensitive)."""
         return (self.seq_parallel == "none" and ctx.mesh is None
-                and self.kv_heads == self.heads
+                and self.heads % self.kv_heads == 0
                 and s % 128 == 0 and self.head_dim % 8 == 0)
 
     def apply(self, params, srcs, ctx):
@@ -214,11 +216,13 @@ class AttentionLayer(Layer):
             v = self._proj(params, self.wv, x, ctx)
             if self.use_rope:
                 q = rope_packed(q, positions, self.heads, self.rope_theta)
-                k = rope_packed(k, positions, self.heads, self.rope_theta)
+                k = rope_packed(k, positions, self.kv_heads,
+                                self.rope_theta)
             from ..ops.attention import flash_blocks
             bq, bk = flash_blocks(s)
+            # custom_vjp + nondiff_argnums: positional args only
             out = flash_attention_packed(q, k, v, self.heads, self.causal,
-                                         bq, bk)
+                                         bq, bk, None, self.kv_heads)
             return self._proj(params, self.wo, out.astype(x.dtype), ctx)
         q, k, v = self.qkv(params, x, jnp.arange(s), ctx)
         k = expand_kv_heads(k, self.heads)

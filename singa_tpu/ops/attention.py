@@ -333,7 +333,8 @@ LOG2E = 1.4426950408889634
 
 
 def _packed_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
-                       acc_ref, *, heads, causal, scale, bq, bk):
+                       acc_ref, *, heads, kv_heads, causal, scale, bq,
+                       bk):
     """All-heads blocks: refs are (1, bq|bk, H·D); the head loop runs
     in-kernel over D-column slices (Mosaic rejects last-dim blocks
     narrower than a lane tile, so per-head blocks of D=64 are not an
@@ -350,6 +351,7 @@ def _packed_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
     ik = pl.program_id(2)
     nk = pl.num_programs(2)
     d = q_ref.shape[-1] // heads
+    grp = heads // kv_heads   # GQA: q heads per kv head
 
     @pl.when(ik == 0)
     def _init():
@@ -361,12 +363,13 @@ def _packed_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
         mask = (_causal_mask_block(iq, ik, bq, bk) if masked else None)
         for h in range(heads):
             sl = slice(h * d, (h + 1) * d)
+            slk = slice((h // grp) * d, (h // grp + 1) * d)
             # operands stay in their input dtype: bf16 x bf16 -> f32
             # runs the MXU at full rate (an f32 upcast halves it); the
             # base-2 scale folds into q in that dtype, flash-standard
             q = q_ref[0, :, sl] * jnp.asarray(scale * LOG2E,
                                               q_ref.dtype)
-            k = k_ref[0, :, sl]
+            k = k_ref[0, :, slk]
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
             if mask is not None:
@@ -378,7 +381,7 @@ def _packed_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
             l_ref[:, h:h + 1] = (l_ref[:, h:h + 1] * alpha
                                  + jnp.sum(p, axis=1, keepdims=True))
             acc_ref[:, sl] = acc_ref[:, sl] * alpha + jax.lax.dot_general(
-                p.astype(v_ref.dtype), v_ref[0, :, sl],
+                p.astype(v_ref.dtype), v_ref[0, :, slk],
                 (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
             m_ref[:, h:h + 1] = m_new
@@ -413,11 +416,13 @@ def _packed_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
 
 
 def _packed_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
-                      dq_ref, acc_ref, *, heads, causal, scale, bq, bk):
+                      dq_ref, acc_ref, *, heads, kv_heads, causal,
+                      scale, bq, bk):
     iq = pl.program_id(1)
     ik = pl.program_id(2)
     nk = pl.num_programs(2)
     d = q_ref.shape[-1] // heads
+    grp = heads // kv_heads
 
     @pl.when(ik == 0)
     def _init():
@@ -427,24 +432,25 @@ def _packed_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
         mask = (_causal_mask_block(iq, ik, bq, bk) if masked else None)
         for h in range(heads):
             sl = slice(h * d, (h + 1) * d)
+            slk = slice((h // grp) * d, (h // grp + 1) * d)
             # operands stay in their input dtype: bf16 x bf16 -> f32
             # runs the MXU at full rate (an f32 upcast halves it); the
             # base-2 scale folds into q in that dtype, flash-standard
             q = q_ref[0, :, sl] * jnp.asarray(scale * LOG2E,
                                               q_ref.dtype)
-            k = k_ref[0, :, sl]
+            k = k_ref[0, :, slk]
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
             if mask is not None:
                 s = jnp.where(mask, s, NEG_INF)
             p = jnp.exp2(s - lse_ref[0, :, h:h + 1] * LOG2E)
             dp = jax.lax.dot_general(
-                do_ref[0, :, sl], v_ref[0, :, sl],
+                do_ref[0, :, sl], v_ref[0, :, slk],
                 (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
             ds = p * (dp - dl_ref[0, :, h:h + 1])
             acc_ref[:, sl] = acc_ref[:, sl] + jax.lax.dot_general(
-                ds.astype(k_ref.dtype), k_ref[0, :, sl],
+                ds.astype(k_ref.dtype), k_ref[0, :, slk],
                 (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
@@ -471,12 +477,13 @@ def _packed_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
 
 
 def _packed_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
-                       dk_ref, dv_ref, dk_acc, dv_acc, *, heads, causal,
-                       scale, bq, bk):
+                       dk_ref, dv_ref, dk_acc, dv_acc, *, heads,
+                       kv_heads, causal, scale, bq, bk):
     ik = pl.program_id(1)
     iq = pl.program_id(2)
     nq = pl.num_programs(2)
     d = q_ref.shape[-1] // heads
+    grp = heads // kv_heads
 
     @pl.when(iq == 0)
     def _init():
@@ -487,27 +494,30 @@ def _packed_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
         mask = (_causal_mask_block(iq, ik, bq, bk) if masked else None)
         for h in range(heads):
             sl = slice(h * d, (h + 1) * d)
+            # GQA: every q head in a group accumulates into its shared
+            # kv slice (the sequential in-kernel adds ARE the head-sum)
+            slk = slice((h // grp) * d, (h // grp + 1) * d)
             # operands stay in their input dtype: bf16 x bf16 -> f32
             # runs the MXU at full rate (an f32 upcast halves it); the
             # base-2 scale folds into q in that dtype, flash-standard
             q = q_ref[0, :, sl] * jnp.asarray(scale * LOG2E,
                                               q_ref.dtype)
-            k = k_ref[0, :, sl]
+            k = k_ref[0, :, slk]
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
             if mask is not None:
                 s = jnp.where(mask, s, NEG_INF)
             p = jnp.exp2(s - lse_ref[0, :, h:h + 1] * LOG2E)
-            dv_acc[:, sl] = dv_acc[:, sl] + jax.lax.dot_general(
+            dv_acc[:, slk] = dv_acc[:, slk] + jax.lax.dot_general(
                 p.astype(do_ref.dtype), do_ref[0, :, sl],
                 (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
             dp = jax.lax.dot_general(
-                do_ref[0, :, sl], v_ref[0, :, sl],
+                do_ref[0, :, sl], v_ref[0, :, slk],
                 (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
             ds = p * (dp - dl_ref[0, :, h:h + 1])
-            dk_acc[:, sl] = dk_acc[:, sl] + jax.lax.dot_general(
+            dk_acc[:, slk] = dk_acc[:, slk] + jax.lax.dot_general(
                 ds.astype(q_ref.dtype), q_ref[0, :, sl],
                 (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -536,18 +546,22 @@ def _packed_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
 
 
 def _packed_forward(q, k, v, num_heads, causal, block_q, block_k,
-                    interpret):
+                    interpret, num_kv_heads=None):
     b, sq, hd = q.shape
     sk = k.shape[1]
     d = hd // num_heads
+    kv_heads = num_kv_heads or num_heads
+    hd_kv = kv_heads * d
+    assert k.shape[-1] == hd_kv, (k.shape, kv_heads, d)
     bq, bk = _fit_block(sq, block_q), _fit_block(sk, block_k)
     assert sq % bq == 0 and sk % bk == 0
     scale = 1.0 / math.sqrt(d)
     q_spec = pl.BlockSpec((1, bq, hd), lambda b_, iq, ik: (b_, iq, 0))
-    k_spec = pl.BlockSpec((1, bk, hd), lambda b_, iq, ik: (b_, ik, 0))
+    k_spec = pl.BlockSpec((1, bk, hd_kv), lambda b_, iq, ik: (b_, ik, 0))
     out, lse = pl.pallas_call(
         functools.partial(_packed_fwd_kernel, heads=num_heads,
-                          causal=causal, scale=scale, bq=bq, bk=bk),
+                          kv_heads=kv_heads, causal=causal, scale=scale,
+                          bq=bq, bk=bk),
         grid=(b, sq // bq, sk // bk),
         in_specs=[q_spec, k_spec, k_spec],
         out_specs=[
@@ -571,10 +585,12 @@ def _packed_forward(q, k, v, num_heads, causal, block_q, block_k,
 
 
 def _packed_backward(q, k, v, out, lse, do, num_heads, causal, block_q,
-                     block_k, interpret):
+                     block_k, interpret, num_kv_heads=None):
     b, sq, hd = q.shape
     sk = k.shape[1]
     d = hd // num_heads
+    kv_heads = num_kv_heads or num_heads
+    hd_kv = kv_heads * d
     bq, bk = _fit_block(sq, block_q), _fit_block(sk, block_k)
     scale = 1.0 / math.sqrt(d)
     # delta[b, s, h] = rowsum(do·out) within head h
@@ -584,12 +600,13 @@ def _packed_backward(q, k, v, out, lse, do, num_heads, causal, block_q,
     dor = do.astype(q.dtype)
 
     q_spec = pl.BlockSpec((1, bq, hd), lambda b_, iq, ik: (b_, iq, 0))
-    k_spec = pl.BlockSpec((1, bk, hd), lambda b_, iq, ik: (b_, ik, 0))
+    k_spec = pl.BlockSpec((1, bk, hd_kv), lambda b_, iq, ik: (b_, ik, 0))
     r_spec = pl.BlockSpec((1, bq, num_heads),
                           lambda b_, iq, ik: (b_, iq, 0))
     dq = pl.pallas_call(
         functools.partial(_packed_dq_kernel, heads=num_heads,
-                          causal=causal, scale=scale, bq=bq, bk=bk),
+                          kv_heads=kv_heads, causal=causal, scale=scale,
+                          bq=bq, bk=bk),
         grid=(b, sq // bq, sk // bk),
         in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
         out_specs=q_spec,
@@ -600,57 +617,61 @@ def _packed_backward(q, k, v, out, lse, do, num_heads, causal, block_q,
     )(q, k, v, dor, lse, delta)
 
     q_spec2 = pl.BlockSpec((1, bq, hd), lambda b_, ik, iq: (b_, iq, 0))
-    k_spec2 = pl.BlockSpec((1, bk, hd), lambda b_, ik, iq: (b_, ik, 0))
+    k_spec2 = pl.BlockSpec((1, bk, hd_kv), lambda b_, ik, iq: (b_, ik, 0))
     r_spec2 = pl.BlockSpec((1, bq, num_heads),
                            lambda b_, ik, iq: (b_, iq, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_packed_dkv_kernel, heads=num_heads,
-                          causal=causal, scale=scale, bq=bq, bk=bk),
+                          kv_heads=kv_heads, causal=causal, scale=scale,
+                          bq=bq, bk=bk),
         grid=(b, sk // bk, sq // bq),
         in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2],
         out_specs=[k_spec2, k_spec2],
-        out_shape=[jax.ShapeDtypeStruct((b, sk, hd), k.dtype),
-                   jax.ShapeDtypeStruct((b, sk, hd), v.dtype)],
-        scratch_shapes=[pltpu.VMEM((bk, hd), jnp.float32),
-                        pltpu.VMEM((bk, hd), jnp.float32)],
+        out_shape=[jax.ShapeDtypeStruct((b, sk, hd_kv), k.dtype),
+                   jax.ShapeDtypeStruct((b, sk, hd_kv), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, hd_kv), jnp.float32),
+                        pltpu.VMEM((bk, hd_kv), jnp.float32)],
         compiler_params=_packed_params(interpret),
         interpret=interpret,
     )(q, k, v, dor, lse, delta)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention_packed(q, k, v, num_heads: int, causal: bool = True,
                            block_q: int = 512, block_k: int = 512,
-                           interpret: Optional[bool] = None):
-    """FlashAttention on the packed projection layout: q/k/v (B, S, H·D)
-    — exactly what the qkv projections emit — with an in-kernel head
-    loop over D-column slices.  No (B,S,H,D)→(B,H,S,D) transposes
-    anywhere: on the 12-head S=1024 bench stack those relayout copies
-    cost ~5ms/step.  Requires num_kv_heads == num_heads (the GQA path
-    keeps the strided layout and expand_kv_heads)."""
+                           interpret: Optional[bool] = None,
+                           num_kv_heads: Optional[int] = None):
+    """FlashAttention on the packed projection layout: q (B, S, H·D),
+    k/v (B, S, Hkv·D) — exactly what the qkv projections emit — with an
+    in-kernel head loop over D-column slices.  No (B,S,H,D)→(B,H,S,D)
+    transposes anywhere: on the 12-head S=1024 bench stack those
+    relayout copies cost ~5ms/step.  GQA runs natively (round 4): each
+    q head reads its group's kv slice in-kernel and the dkv kernel's
+    sequential per-head adds ARE the group sum — no expand_kv_heads
+    materialization, no strided fallback."""
     if interpret is None:
         interpret = not _on_tpu()
     return _packed_forward(q, k, v, num_heads, causal, block_q, block_k,
-                           interpret)[0]
+                           interpret, num_kv_heads)[0]
 
 
 def _packed_vjp_fwd(q, k, v, num_heads, causal, block_q, block_k,
-                    interpret):
+                    interpret, num_kv_heads=None):
     if interpret is None:
         interpret = not _on_tpu()
     out, lse = _packed_forward(q, k, v, num_heads, causal, block_q,
-                               block_k, interpret)
+                               block_k, interpret, num_kv_heads)
     return out, (q, k, v, out, lse)
 
 
-def _packed_vjp_bwd(num_heads, causal, block_q, block_k, interpret, res,
-                    g):
+def _packed_vjp_bwd(num_heads, causal, block_q, block_k, interpret,
+                    num_kv_heads, res, g):
     q, k, v, out, lse = res
     if interpret is None:
         interpret = not _on_tpu()
     return _packed_backward(q, k, v, out, lse, g, num_heads, causal,
-                            block_q, block_k, interpret)
+                            block_q, block_k, interpret, num_kv_heads)
 
 
 flash_attention_packed.defvjp(_packed_vjp_fwd, _packed_vjp_bwd)

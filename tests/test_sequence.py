@@ -403,3 +403,69 @@ def test_attention_layer_packed_path_matches_strided():
     for k in g1:
         np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g2[k]),
                                    rtol=1e-3, atol=1e-5, err_msg=k)
+
+
+def test_packed_flash_gqa_matches_expanded_reference():
+    """Native GQA in the packed kernels (q heads read their group's kv
+    slice in-kernel): forward and all three input grads vs the dense
+    reference on expanded kv heads."""
+    from singa_tpu.ops.attention import (expand_kv_heads,
+                                         flash_attention_packed)
+
+    b, h, hkv, s, d = 2, 8, 2, 256, 16
+    q = jnp.asarray(RNG.standard_normal((b, s, h * d)).astype(np.float32))
+    k = jnp.asarray(RNG.standard_normal((b, s, hkv * d)).astype(np.float32))
+    v = jnp.asarray(RNG.standard_normal((b, s, hkv * d)).astype(np.float32))
+    cot = jnp.asarray(RNG.standard_normal(q.shape).astype(np.float32))
+
+    def ref(q, k, v, causal):
+        qs = q.reshape(b, s, h, d).transpose(0, 2, 1, 3)
+        ks = expand_kv_heads(
+            k.reshape(b, s, hkv, d).transpose(0, 2, 1, 3), h)
+        vs = expand_kv_heads(
+            v.reshape(b, s, hkv, d).transpose(0, 2, 1, 3), h)
+        o = attention_reference(qs, ks, vs, causal)
+        return o.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+
+    for causal in (False, True):
+        out_p, vjp_p = jax.vjp(
+            lambda *a: flash_attention_packed(
+                *a, h, causal, 128, 128, True, hkv), q, k, v)
+        out_r, vjp_r = jax.vjp(lambda *a: ref(*a, causal), q, k, v)
+        np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_r),
+                                   rtol=1e-3, atol=1e-4)
+        for a, r in zip(vjp_p(cot), vjp_r(cot)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                       rtol=1e-3, atol=1e-4)
+
+
+def test_attention_layer_gqa_packed_matches_strided():
+    """A GQA config now takes the packed path single-device; it must
+    reproduce the strided expand_kv_heads path exactly."""
+    from singa_tpu.core.net import build_net
+    from singa_tpu.models.transformer import (synthetic_token_batches,
+                                              transformer_lm)
+
+    cfg = transformer_lm(vocab_size=64, num_layers=1, embed_dim=64,
+                         num_heads=4, head_dim=16, num_kv_heads=2,
+                         seq_len=128, batchsize=2)
+    net = build_net(cfg, "kTrain",
+                    {"data": {"input": (128,), "target": (128,)}})
+    params = net.init_params(jax.random.PRNGKey(0))
+    batch = next(synthetic_token_batches(2, 128, 64))
+    attn = [l for l in net.layers.values()
+            if l.cfg.type == "kAttention"][0]
+    assert attn.kv_heads == 2
+    assert attn._packed_eligible(128, type("C", (), {"mesh": None})())
+
+    def loss_fn(p):
+        loss, _, _ = net.apply(p, batch, rng=jax.random.PRNGKey(1),
+                               train=False)
+        return loss
+    l1, g1 = jax.value_and_grad(loss_fn)(params)
+    attn._packed_eligible = lambda s, ctx: False
+    l2, g2 = jax.value_and_grad(loss_fn)(params)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    for k in g1:
+        np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g2[k]),
+                                   rtol=1e-3, atol=1e-5, err_msg=k)
