@@ -585,7 +585,7 @@ def _packed_forward(q, k, v, num_heads, causal, block_q, block_k,
 
 
 def _packed_backward(q, k, v, out, lse, do, num_heads, causal, block_q,
-                     block_k, interpret, num_kv_heads=None):
+                     block_k, interpret, num_kv_heads=None, dlse=None):
     b, sq, hd = q.shape
     sk = k.shape[1]
     d = hd // num_heads
@@ -593,10 +593,14 @@ def _packed_backward(q, k, v, out, lse, do, num_heads, causal, block_q,
     hd_kv = kv_heads * d
     bq, bk = _fit_block(sq, block_q), _fit_block(sk, block_k)
     scale = 1.0 / math.sqrt(d)
-    # delta[b, s, h] = rowsum(do·out) within head h
+    # delta[b, s, h] = rowsum(do·out) within head h; when the lse
+    # output is live (ring merging differentiates through it), its
+    # cotangent joins here: ds = p·(dp − (rowsum(do·out) − dlse))
     delta = jnp.sum(
         (do.astype(jnp.float32) * out.astype(jnp.float32))
         .reshape(b, sq, num_heads, d), axis=-1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
     dor = do.astype(q.dtype)
 
     q_spec = pl.BlockSpec((1, bq, hd), lambda b_, iq, ik: (b_, iq, 0))
@@ -675,6 +679,76 @@ def _packed_vjp_bwd(num_heads, causal, block_q, block_k, interpret,
 
 
 flash_attention_packed.defvjp(_packed_vjp_fwd, _packed_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def flash_attention_packed_lse(q, k, v, num_heads: int,
+                               causal: bool = True, block_q: int = 512,
+                               block_k: int = 512,
+                               interpret: Optional[bool] = None,
+                               num_kv_heads: Optional[int] = None):
+    """flash_attention_packed that ALSO returns the natural log-sum-exp
+    (B, S, H) — the mergeable (normalized out, lse) pair ring attention
+    needs for its per-rotation partials.  Differentiable in both
+    outputs: the backward folds the lse cotangent into the delta term
+    (ds = p·(dp − (rowsum(do·out) − dlse)))."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _packed_forward(q, k, v, num_heads, causal, block_q, block_k,
+                           interpret, num_kv_heads)
+
+
+def _packed_lse_vjp_fwd(q, k, v, num_heads, causal, block_q, block_k,
+                        interpret, num_kv_heads=None):
+    if interpret is None:
+        interpret = not _on_tpu()
+    out, lse = _packed_forward(q, k, v, num_heads, causal, block_q,
+                               block_k, interpret, num_kv_heads)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _packed_lse_vjp_bwd(num_heads, causal, block_q, block_k, interpret,
+                        num_kv_heads, res, g):
+    q, k, v, out, lse = res
+    do, dlse = g
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _packed_backward(q, k, v, out, lse, do, num_heads, causal,
+                            block_q, block_k, interpret, num_kv_heads,
+                            dlse=dlse)
+
+
+flash_attention_packed_lse.defvjp(_packed_lse_vjp_fwd,
+                                  _packed_lse_vjp_bwd)
+
+
+def flash_chunk(q, k, v, causal: bool,
+                interpret: Optional[bool] = None,
+                block_q: int = 512, block_k: int = 512):
+    """Ring-attention local step on the Pallas kernels: strided
+    (B, H, Sq, D) × (B, H, Sk, D) → (normalized out f32, natural lse
+    (B, H, Sq, 1)) — the same mergeable contract as chunk_attention.
+    Only legal for equal q/kv offsets (the diagonal rotation) or
+    causal=False (fully-visible rotations); the ring driver picks the
+    case per rotation."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    out, lse = flash_attention_packed_lse(
+        q.reshape(b * h, sq, d), k.reshape(b * h, sk, d),
+        v.reshape(b * h, sk, d), 1, causal, block_q, block_k, interpret)
+    return (out.reshape(b, h, sq, d).astype(jnp.float32),
+            lse.reshape(b, h, sq, 1))
+
+
+def flash_chunk_legal(sq: int, sk: int, d: int) -> bool:
+    """Whether flash_chunk's kernels can tile these local chunk shapes
+    ((8, 128)-tile-able blocks; see _fit_block)."""
+    def ok(n):
+        c = min(512, n)
+        while n % c:
+            c //= 2
+        return c % 8 == 0
+    return sq >= 8 and sk >= 8 and d % 8 == 0 and ok(sq) and ok(sk)
 
 
 def rope_packed(x: jnp.ndarray, positions: jnp.ndarray, num_heads: int,

@@ -25,7 +25,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from ..ops.attention import (NEG_INF, attention_reference,
-                             chunk_attention_blockwise, merge_attention)
+                             chunk_attention_blockwise, flash_chunk,
+                             flash_chunk_legal, merge_attention)
 
 
 def _spec(mesh: Mesh, seq_axis: str, heads: int):
@@ -36,13 +37,58 @@ def _spec(mesh: Mesh, seq_axis: str, heads: int):
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
-                   causal: bool = True) -> jnp.ndarray:
+                   causal: bool = True,
+                   use_flash: bool | None = None) -> jnp.ndarray:
     """q/k/v: (B, H, S, D) with S sharded over `axis`.  Returns attention
-    output with the same sharding."""
+    output with the same sharding.
+
+    Local step: the Pallas flash kernels when the chunk shapes tile
+    (`use_flash` None = auto).  Under a causal mask every ring rotation
+    is one of exactly three cases — diagonal (kv_off == q_off: the
+    standard causal kernel), fully visible (kv strictly earlier:
+    non-causal kernel), fully masked (kv strictly later: contributes
+    nothing) — so the offset-aware mask the XLA fallback needs never
+    enters the kernel; a lax.cond picks visible-vs-masked per device.
+    The rotation loop is Python-unrolled (nseq is static), making the
+    per-rotation case static too."""
     nseq = mesh.shape[axis]
     if nseq == 1:
         return attention_reference(q, k, v, causal)
     spec = _spec(mesh, axis, q.shape[1])
+    b, h, s_global, d = q.shape
+    chunk = s_global // nseq
+    if use_flash is None:
+        use_flash = flash_chunk_legal(chunk, chunk, d)
+
+    def local_flash(q, k, v):
+        idx = jax.lax.axis_index(axis)
+        perm = [(i, (i + 1) % nseq) for i in range(nseq)]
+        out = jnp.zeros(q.shape, jnp.float32)
+        lse = jnp.full(q.shape[:3] + (1,), NEG_INF, jnp.float32)
+        k_cur, v_cur = k, v
+        for s in range(nseq):
+            if not causal:
+                o_new, l_new = flash_chunk(q, k_cur, v_cur, False)
+            elif s == 0:
+                # diagonal: kv_off == q_off on every device
+                o_new, l_new = flash_chunk(q, k_cur, v_cur, True)
+            else:
+                # kv chunk s hops back: visible iff it wrapped no ring
+                # boundary (idx >= s); otherwise it is entirely in the
+                # future and contributes nothing
+                o_new, l_new = jax.lax.cond(
+                    idx >= s,
+                    lambda args: flash_chunk(*args, False),
+                    lambda args: (
+                        jnp.zeros(args[0].shape, jnp.float32),
+                        jnp.full(args[0].shape[:3] + (1,), NEG_INF,
+                                 jnp.float32)),
+                    (q, k_cur, v_cur))
+            out, lse = merge_attention(out, lse, o_new, l_new)
+            if s < nseq - 1:
+                k_cur = jax.lax.ppermute(k_cur, axis, perm)
+                v_cur = jax.lax.ppermute(v_cur, axis, perm)
+        return out.astype(q.dtype)
 
     def local(q, k, v):
         idx = jax.lax.axis_index(axis)
@@ -69,7 +115,8 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
             step, (k, v, out0, lse0), jnp.arange(nseq))
         return out.astype(q.dtype)
 
-    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+    return shard_map(local_flash if use_flash else local, mesh=mesh,
+                     in_specs=(spec, spec, spec),
                      out_specs=spec, check_vma=False)(q, k, v)
 
 

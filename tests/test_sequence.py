@@ -469,3 +469,25 @@ def test_attention_layer_gqa_packed_matches_strided():
     for k in g1:
         np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g2[k]),
                                    rtol=1e-3, atol=1e-5, err_msg=k)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_and_blockwise_paths_agree(causal):
+    """Both ring local-step implementations — the Pallas flash unrolled
+    rotation (use_flash=True) and the XLA blockwise scan fallback — must
+    match the dense reference and each other, gradients included."""
+    q, k, v = _qkv(1, 4, 256, 16)
+    mesh = make_mesh(seq=8)
+    of = ring_attention(q, k, v, mesh, "seq", causal, use_flash=True)
+    ob = ring_attention(q, k, v, mesh, "seq", causal, use_flash=False)
+    ref = attention_reference(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(of), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(of), np.asarray(ob),
+                               rtol=1e-4, atol=1e-5)
+    gf = jax.grad(lambda k: ring_attention(
+        q, k, v, mesh, "seq", causal, use_flash=True).sum())(k)
+    gr = jax.grad(lambda k: attention_reference(
+        q, k, v, causal).sum())(k)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                               rtol=1e-4, atol=1e-5)
