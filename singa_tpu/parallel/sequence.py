@@ -124,10 +124,23 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "seq",
                       causal: bool = True,
                       attn_fn=None) -> jnp.ndarray:
     """Ulysses SP: all-to-all seq→heads, local full-sequence attention,
-    all-to-all back.  q/k/v: (B, H, S, D), S sharded over `axis`."""
+    all-to-all back.  q/k/v: (B, H, S, D), S sharded over `axis`.
+
+    The local step defaults to the Pallas flash kernel (the post-a2a
+    chunk is FULL sequence length with no position offsets — plain
+    causal attention, exactly the kernel's contract) whenever the
+    global S and D tile; dense reference otherwise or when attn_fn is
+    given."""
     nseq = mesh.shape[axis]
+    s_global, d = q.shape[2], q.shape[3]
     if attn_fn is None:
-        attn_fn = attention_reference
+        if flash_chunk_legal(s_global, s_global, d):
+            from ..ops.attention import flash_attention, flash_blocks
+            bq, bk = flash_blocks(s_global)
+            attn_fn = lambda q, k, v, c: flash_attention(  # noqa: E731
+                q, k, v, c, bq, bk)
+        else:
+            attn_fn = attention_reference
     if nseq == 1:
         return attn_fn(q, k, v, causal)
     h = q.shape[1]
