@@ -172,3 +172,27 @@ def test_size10_param_partitions_on_model4_matches_unsharded():
     # the 10-wide fc_dst weight runs partition-constrained: ceil(10/4)=3
     hlo = tr_mesh.train_step.lower(sp, so, sb, 0, rng).compile().as_text()
     assert "3]" in hlo and "dynamic-slice" in hlo
+
+
+def test_indivisible_batch_partition_matches_unpartitioned():
+    """kDataPartition on a batch that doesn't divide the data axis
+    (6 over data=2... and 10 over 4-wide model meshes): GSPMD's
+    implicit pad must not change numerics."""
+    mesh = make_mesh(jax.devices(), data=4, model=2)
+    cfg = _cfg("kDataPartition", "kDataPartition")
+    cfg.neuralnet.layer[0].data_param.batchsize = 6
+    net = build_net(cfg, "kTrain", SHAPES)
+    params = net.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(9)
+    batch = {"data": {
+        "pixel": jnp.asarray(rng.standard_normal((6, 16)), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 10, (6,)))}}
+    l0, g0 = jax.jit(jax.value_and_grad(
+        lambda p, b: net.apply(p, b, train=True)[0]))(params, batch)
+    l1, g1 = jax.jit(jax.value_and_grad(
+        lambda p, b: net.apply(p, b, train=True, mesh=mesh)[0]))(
+            params, batch)
+    assert np.allclose(float(l0), float(l1), rtol=1e-5)
+    for k in g0:
+        np.testing.assert_allclose(np.asarray(g0[k]), np.asarray(g1[k]),
+                                   rtol=1e-4, atol=1e-6, err_msg=k)
