@@ -19,7 +19,6 @@ import sys
 
 from .config import load_cluster_config, load_model_config
 from .core.trainer import Trainer
-from .data.synthetic import synthetic_image_batches
 
 
 def make_argparser() -> argparse.ArgumentParser:
