@@ -1,0 +1,58 @@
+#!/usr/bin/env bash
+# Place per-process shard folders onto their hosts.
+#
+# Successor of the reference's cluster ops glue (script/load_data.py's
+# placement step + script/node.sh's ssh fan-out): after
+#   python -m singa_tpu.tools.loader partition <shard_dir> <out_dir> \
+#       --nworker_groups G --nworkers_per_group W [--replicate]
+# has produced <out_dir>/proc{i}/ folders, this pushes proc{i} to
+# <remote_dir>/proc{i}/ on the i-th host of a hostfile (same format
+# main.py consumes: one "host" or "host:port" per line, '#' comments
+# and blank lines skipped, line i = process i — the port names the
+# process, not the ssh target, so it is stripped for rsync; keeping
+# the proc{i} suffix remotely means several processes on one host
+# never collide).  Point each process at <remote_dir>/proc{i}.
+#
+# Usage: scripts/place_shards.sh <out_dir> <hostfile> <remote_dir> [run]
+#   scripts/place_shards.sh data/parts hostfile /data/singa run
+# Without the trailing "run" it prints the rsync commands (dry run) —
+# the honest default for an ops script that mutates remote hosts.
+set -euo pipefail
+
+if [ $# -lt 3 ]; then
+  echo "usage: $0 <out_dir> <hostfile> <remote_dir> [run]" >&2
+  exit 1
+fi
+out_dir=$1; hostfile=$2; remote_dir=$3; mode=${4:-dry}
+
+i=0
+pids=()
+hosts=()
+# `|| [ -n "$host" ]` keeps a final line without a trailing newline
+while read -r host _ || [ -n "${host:-}" ]; do
+  case "${host:-}" in ''|'#'*) continue ;; esac
+  src="$out_dir/proc$i"
+  if [ ! -d "$src" ]; then
+    echo "warning: $src missing (fewer partitions than hosts?)" >&2
+    i=$((i + 1)); continue
+  fi
+  ssh_host=${host%%:*}
+  cmd=(rsync -az --mkpath "$src/" "$ssh_host:$remote_dir/proc$i/")
+  if [ "$mode" = run ]; then
+    echo "+ ${cmd[*]}" >&2
+    "${cmd[@]}" &
+    pids+=($!); hosts+=("$host")
+  else
+    echo "${cmd[*]}"
+  fi
+  i=$((i + 1))
+done < "$hostfile"
+
+fail=0
+for j in "${!pids[@]}"; do
+  if ! wait "${pids[$j]}"; then
+    echo "ERROR: placement to ${hosts[$j]} failed" >&2
+    fail=1
+  fi
+done
+exit $fail

@@ -80,7 +80,8 @@ def sync_sample_ratio(bandwidth_mb_s: float, nservers: int, nworkers: int,
     the fraction of the model that fits through the pipe per step."""
     if model_size_floats <= 0 or compute_time_s <= 0:
         return 1.0
-    throughput = bandwidth_mb_s * 1e6 / 4.0 * nservers   # floats/sec
+    # MB means 1024*1024 here, matching the reference formula's units
+    throughput = bandwidth_mb_s * 1024 * 1024 / 4.0 * nservers  # floats/sec
     demand = model_size_floats * nworkers / compute_time_s
     return float(max(0.0, min(1.0, throughput / demand)))
 

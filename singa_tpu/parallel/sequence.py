@@ -60,6 +60,11 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
     if use_flash is None:
         use_flash = flash_chunk_legal(chunk, chunk, d)
 
+    # per-chunk tuned block geometry (bk=1024 wins for chunks >= 1024,
+    # same table as the single-device and Ulysses paths)
+    from ..ops.attention import flash_blocks
+    fbq, fbk = flash_blocks(chunk)
+
     def local_flash(q, k, v):
         idx = jax.lax.axis_index(axis)
         perm = [(i, (i + 1) % nseq) for i in range(nseq)]
@@ -68,17 +73,20 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
         k_cur, v_cur = k, v
         for s in range(nseq):
             if not causal:
-                o_new, l_new = flash_chunk(q, k_cur, v_cur, False)
+                o_new, l_new = flash_chunk(q, k_cur, v_cur, False,
+                                           block_q=fbq, block_k=fbk)
             elif s == 0:
                 # diagonal: kv_off == q_off on every device
-                o_new, l_new = flash_chunk(q, k_cur, v_cur, True)
+                o_new, l_new = flash_chunk(q, k_cur, v_cur, True,
+                                           block_q=fbq, block_k=fbk)
             else:
                 # kv chunk s hops back: visible iff it wrapped no ring
                 # boundary (idx >= s); otherwise it is entirely in the
                 # future and contributes nothing
                 o_new, l_new = jax.lax.cond(
                     idx >= s,
-                    lambda args: flash_chunk(*args, False),
+                    lambda args: flash_chunk(*args, False,
+                                             block_q=fbq, block_k=fbk),
                     lambda args: (
                         jnp.zeros(args[0].shape, jnp.float32),
                         jnp.full(args[0].shape[:3] + (1,), NEG_INF,

@@ -57,9 +57,11 @@ def test_randomsync_exchanges_masked_entries():
 
 
 def test_sync_sample_ratio_formula():
-    # throughput = 100MB/s /4 *1 server = 25e6 floats/s;
-    # demand = 1e6 floats * 50 workers / 1s = 5e7 -> ratio 0.5
-    assert sync_sample_ratio(100, 1, 50, 1_000_000, 1.0) == pytest.approx(0.5)
+    # throughput = 100MB/s (MB = 1024*1024, the reference's units)
+    # / 4 bytes * 1 server = 26,214,400 floats/s;
+    # demand = 1e6 floats * 50 workers / 1s = 5e7 -> ratio 0.524288
+    assert sync_sample_ratio(100, 1, 50, 1_000_000, 1.0) == pytest.approx(
+        100 * 1024 * 1024 / 4 / 5e7)
     assert sync_sample_ratio(1e9, 1, 1, 1000, 1.0) == 1.0
     assert sync_sample_ratio(100, 1, 1, 0, 1.0) == 1.0
 
@@ -372,9 +374,10 @@ def test_configure_sync_sets_sample_ratio_deterministically():
                         param_type="RandomSync", sync_frequency=1,
                         warmup_steps=2)
     ctl = ElasticController(cfg, ngroups=1, bandwidth_mb_s=0.3)
-    # throughput = 0.3 MB/s / 4 B = 75e3 floats/s; demand = 250e3/1s
+    # throughput = 0.3 MB/s (MB = 1024*1024) / 4 B = 78,643.2 floats/s;
+    # demand = 250e3 floats / 1s
     ctl.configure_sync(1.0, 250_000, 1)
-    assert ctl.sample_ratio == pytest.approx(0.3)
+    assert ctl.sample_ratio == pytest.approx(0.3 * 1024 * 1024 / 1e6)
     off = ElasticController(cfg, ngroups=1, bandwidth_mb_s=0.0)
     off.configure_sync(1.0, 250_000, 1)
     assert off.sample_ratio == 1.0

@@ -22,7 +22,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def measure(seq_len, batch, iters, reps, bq, bk, split=True):
+def measure(seq_len, batch, iters, reps, bq, bk, split=False,
+            head_dim=64):
     import jax
 
     from singa_tpu.core.trainer import Trainer
@@ -33,10 +34,17 @@ def measure(seq_len, batch, iters, reps, bq, bk, split=True):
     from singa_tpu.utils.profiler import hard_sync
 
     attention.set_flash_blocks((bq, bk))
+    prev_split = attention.MASK_SPLIT
     attention.MASK_SPLIT = split
     try:
+        # heads scale inversely with head_dim so every sweep point keeps
+        # the same 768-wide attention (12x64 default, 6x128 for the
+        # D=128 floor-proof measurement)
+        if 768 % head_dim:
+            raise ValueError(f"--head_dim must divide 768, got {head_dim}")
         cfg = transformer_lm(vocab_size=32768, num_layers=12,
-                             embed_dim=768, num_heads=12, head_dim=64,
+                             embed_dim=768, num_heads=768 // head_dim,
+                             head_dim=head_dim,
                              seq_len=seq_len, batchsize=batch)
         cfg.precision = "bfloat16"
         trainer = Trainer(cfg, {"data": {"input": (seq_len,),
@@ -60,7 +68,7 @@ def measure(seq_len, batch, iters, reps, bq, bk, split=True):
         return best, mfu(flops, best), flops
     finally:
         attention.set_flash_blocks(None)
-        attention.MASK_SPLIT = True
+        attention.MASK_SPLIT = prev_split
 
 
 def main():
@@ -71,18 +79,22 @@ def main():
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--blocks", default="512x512,1024x512,512x1024,"
                                         "1024x1024,2048x512,256x512")
+    ap.add_argument("--head_dim", type=int, default=64)
     args = ap.parse_args()
     batch = args.batch or max(32 * 1024 // args.seq, 1)
-    print(f"# S={args.seq} batch={batch} iters={args.iters} "
-          f"reps={args.reps} (best-of)")
+    print(f"# S={args.seq} batch={batch} head_dim={args.head_dim} "
+          f"iters={args.iters} reps={args.reps} (best-of)")
     base = None
     for spec in args.blocks.split(","):
-        split = not spec.endswith(":nosplit")
+        # production runs MASK_SPLIT=False (BASELINE: -55% at 512x1024);
+        # ':split' opts a sweep point into the A/B variant
+        split = spec.endswith(":split")
         bq, bk = (int(x) for x in spec.split(":")[0].split("x"))
-        tag = "" if split else " nosplit"
+        tag = " split" if split else ""
         try:
             step, util, flops = measure(args.seq, batch, args.iters,
-                                        args.reps, bq, bk, split)
+                                        args.reps, bq, bk, split,
+                                        args.head_dim)
         except Exception as e:
             print(f"bq={bq:5d} bk={bk:5d}{tag}  FAILED: "
                   f"{type(e).__name__}: {str(e)[:110]}", flush=True)
