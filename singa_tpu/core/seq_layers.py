@@ -189,21 +189,32 @@ class AttentionLayer(Layer):
             k = rope(k, positions, self.rope_theta)
         return q, k, v
 
-    def _packed_eligible(self, s: int, ctx) -> bool:
-        """The zero-transpose packed flash path: single-device attention
-        on flash-legal shapes, GQA included (the kernels read each q
-        head's group kv slice in-kernel — no expand_kv_heads copies).
-        Mesh runs keep the strided path so GSPMD sees the same operand
-        structure as before (head-sharded custom calls are
-        propagation-sensitive)."""
-        return (self.seq_parallel == "none" and ctx.mesh is None
+    def _packed_eligible(self, b: int, s: int, ctx) -> bool:
+        """The zero-transpose packed flash path: flash-legal shapes, GQA
+        included (the kernels read each q head's group kv slice
+        in-kernel — no expand_kv_heads copies).  Since round 5 mesh runs
+        take it too, as a shard_map local step (batch on "data", heads
+        on "model" — parallel.sequence.packed_attention_sharded), when
+        the batch/head counts split evenly over those axes; "seq" must
+        be unsharded (a sharded S would need offset-aware masks) and
+        "pipe" never reaches here (stage bodies see ctx.mesh None)."""
+        if not (self.seq_parallel == "none"
                 and self.heads % self.kv_heads == 0
-                and s % 128 == 0 and self.head_dim % 8 == 0)
+                and s % 128 == 0 and self.head_dim % 8 == 0):
+            return False
+        if ctx.mesh is None:
+            return True
+        shape = dict(ctx.mesh.shape)
+        tp = shape.get("model", 1)
+        return (shape.get("seq", 1) == 1 and shape.get("pipe", 1) == 1
+                and b % max(shape.get("data", 1), 1) == 0
+                and self.heads % max(tp, 1) == 0
+                and self.kv_heads % max(tp, 1) == 0)
 
     def apply(self, params, srcs, ctx):
         x = srcs[0]
         b, s, e = x.shape
-        if self._packed_eligible(s, ctx):
+        if self._packed_eligible(b, s, ctx):
             # packed path: (B, S, H·D) end to end — the projection
             # output feeds the kernel directly and the kernel output
             # feeds wo directly.  The (B,S,H,D)→(B,H,S,D) transposes of
@@ -220,21 +231,31 @@ class AttentionLayer(Layer):
                                 self.rope_theta)
             from ..ops.attention import flash_blocks
             bq, bk = flash_blocks(s)
-            # custom_vjp + nondiff_argnums: positional args only
-            out = flash_attention_packed(q, k, v, self.heads, self.causal,
-                                         bq, bk, None, self.kv_heads)
+            if ctx.mesh is not None:
+                from ..parallel.sequence import packed_attention_sharded
+                out = packed_attention_sharded(
+                    q, k, v, ctx.mesh, self.heads, self.kv_heads,
+                    self.causal, bq, bk)
+            else:
+                # custom_vjp + nondiff_argnums: positional args only
+                out = flash_attention_packed(q, k, v, self.heads,
+                                             self.causal, bq, bk, None,
+                                             self.kv_heads)
             return self._proj(params, self.wo, out.astype(x.dtype), ctx)
         q, k, v = self.qkv(params, x, jnp.arange(s), ctx)
-        k = expand_kv_heads(k, self.heads)
-        v = expand_kv_heads(v, self.heads)
 
         if self.seq_parallel == "ring" and ctx.mesh is not None:
+            # k/v stay at Hkv width: the ring rotates (and Ulysses
+            # all-to-alls) unexpanded KV; group expansion happens on
+            # the local chunk inside the SP step (round 5)
             from ..parallel.sequence import ring_attention
             out = ring_attention(q, k, v, ctx.mesh, "seq", self.causal)
         elif self.seq_parallel == "ulysses" and ctx.mesh is not None:
             from ..parallel.sequence import ulysses_attention
             out = ulysses_attention(q, k, v, ctx.mesh, "seq", self.causal)
         elif s % 128 == 0 and self.head_dim % 8 == 0:
+            k = expand_kv_heads(k, self.heads)
+            v = expand_kv_heads(v, self.heads)
             from ..ops.attention import flash_blocks
             out = flash_attention(q, k, v, self.causal, *flash_blocks(s))
         else:
@@ -250,7 +271,9 @@ class AttentionLayer(Layer):
                       f"back to dense O(S^2)-memory attention — the "
                       f"flash kernel needs seq_len % 128 == 0 and "
                       f"head_dim % 8 == 0", file=sys.stderr)
-            out = attention_reference(q, k, v, self.causal)
+            out = attention_reference(q, expand_kv_heads(k, self.heads),
+                                      expand_kv_heads(v, self.heads),
+                                      self.causal)
         out = out.transpose(0, 2, 1, 3).reshape(b, s, -1)
         return self._proj(params, self.wo, out.astype(x.dtype), ctx)
 
@@ -436,6 +459,30 @@ class LMHeadLossLayer(Layer, _HeadProjection):
         return (self.topk == 1 and is_vE and _on_tpu()
                 and eligible(h2, w))
 
+    @staticmethod
+    def _shard_tokens(h2, l2, b, s, ctx):
+        """Keep the flattened (B·S, ·) token dim sharded over
+        ("data", "seq") jointly.  Without this constraint GSPMD resolves
+        the (B, S, E)→(B·S, E) reshape under sequence parallelism by
+        ALL-GATHERING the full sequence per data shard (observed in
+        lowered HLO: an f32[B/dp, S, E] gather) — which defeats the
+        O(S/n) activation memory SP exists for.  The merge is exact: B
+        rides "data" major, S rides "seq" minor, so the merged dim
+        shards over the axis product with no data movement."""
+        if ctx.mesh is None:
+            return h2, l2
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        shape = dict(ctx.mesh.shape)
+        dp, sp = shape.get("data", 1), shape.get("seq", 1)
+        if sp <= 1 or b % dp or s % sp:
+            return h2, l2
+        tok = P(("data", "seq"))
+        h2 = jax.lax.with_sharding_constraint(
+            h2, NamedSharding(ctx.mesh, P(("data", "seq"), None)))
+        l2 = jax.lax.with_sharding_constraint(
+            l2, NamedSharding(ctx.mesh, tok))
+        return h2, l2
+
     def apply(self, params, srcs, ctx):
         from ..ops.head_loss import fused_lm_xent
         from ..ops.loss import chunked_lm_xent
@@ -443,6 +490,7 @@ class LMHeadLossLayer(Layer, _HeadProjection):
         w, is_vE = self.head_weight(params, ctx.compute_dtype)
         b, s, e = hidden.shape
         h2, l2 = hidden.reshape(b * s, e), labels.reshape(-1)
+        h2, l2 = self._shard_tokens(h2, l2, b, s, ctx)
         # fused Pallas forward (one pass over vocab blocks, logits
         # VMEM-only — ops/head_loss.py) for tied heads at kernel-legal
         # shapes; the chunked XLA path covers everything else

@@ -76,9 +76,15 @@ class TimerInfo:
                  for k, v in self.times.items()]
         out = "Time per step — " + ", ".join(parts)
         if self.phase_shares:
+            shares = dict(self.phase_shares)
+            cov = shares.pop("coverage", None)
             out += " [device: " + ", ".join(
-                f"{k} {100 * v:.0f}%"
-                for k, v in self.phase_shares.items()) + "]"
+                f"{k} {100 * v:.0f}%" for k, v in shares.items())
+            if cov is not None:
+                # fusion blur can swallow a phase (classify_phase);
+                # the coverage qualifier keeps "update 0%" honest
+                out += f" — {100 * cov:.0f}% of device time attributed"
+            out += "]"
         return out
 
     def reset(self) -> None:

@@ -140,8 +140,14 @@ def classify_phase(tag: str) -> str:
 
 
 def phase_shares(outdir: str, compiled_text: str) -> dict:
-    """{"fwd": f, "bwd": b, "update": u} fractions of attributed device
-    time, from a captured trace + the compiled module text."""
+    """{"fwd": f, "bwd": b, "update": u, "coverage": c} — phase
+    fractions of ATTRIBUTED device time plus the attributed/total
+    coverage ratio, from a captured trace + the compiled module text.
+    Coverage travels with the shares so the report can qualify them:
+    a fusion spanning phases keeps one representative metadata (see
+    classify_phase), and at small-model scale that blur can swallow a
+    whole phase — "update 0%" with 70% coverage is attribution loss,
+    not a free optimizer."""
     per_op, total = parse_trace_ops(outdir)
     attr = hlo_attribution(compiled_text)
     shares = {"fwd": 0.0, "bwd": 0.0, "update": 0.0}
@@ -153,7 +159,9 @@ def phase_shares(outdir: str, compiled_text: str) -> dict:
         attributed += us
         shares[classify_phase(tag)] += us
     denom = attributed or total or 1
-    return {k: v / denom for k, v in shares.items()}
+    out = {k: v / denom for k, v in shares.items()}
+    out["coverage"] = attributed / (total or 1)
+    return out
 
 
 def flops_of(fn, *args) -> Optional[float]:

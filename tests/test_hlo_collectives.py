@@ -1,0 +1,136 @@
+"""HLO collective-regression tests (VERDICT r4 item 4): lower the
+sharded steps on the 8-device CPU mesh and assert the expected
+collective set — ppermute counts per ring layer, all-to-alls for
+Ulysses, psum for DP grads, and critically NO all-gather of a full
+parameter or full-sequence activation.  This is the only multi-chip
+perf guard available without hardware; the round-3 hybrid remat
+regression and the round-5 loss-reshape full-S gather would both have
+been caught here mechanically.
+
+The reference's connector insertion was exact by construction
+(neuralnet.cc:229-290); these tests pin the GSPMD-compiled equivalent.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from singa_tpu.core.trainer import Trainer
+from singa_tpu.models.transformer import (synthetic_token_batches,
+                                          transformer_lm)
+from singa_tpu.parallel import (make_mesh, param_shardings, ring_attention,
+                                seq_batch_shardings, ulysses_attention)
+
+RNG = np.random.default_rng(0)
+
+
+def collective_defs(txt: str, op: str):
+    """(dtype, dims) of every `op` definition site in compiled HLO."""
+    return re.findall(rf"(\S+)\[([0-9,]*)\][^\n]* {op}\(", txt)
+
+
+def _sharded_step_text(mesh, cfg, bs, seq, vocab=64):
+    shapes = {"data": {"input": (seq,), "target": (seq,)}}
+    tr = Trainer(cfg, shapes, donate=False, mesh=mesh)
+    p, o = tr.init(0)
+    psh = param_shardings(mesh, tr.train_net)
+    sp = {k: jax.device_put(v, psh[k]) for k, v in p.items()}
+    so = {k: {n: jax.device_put(v, psh[n]) for n, v in t.items()}
+          for k, t in o.items()}
+    b = next(synthetic_token_batches(bs, seq, vocab))
+    sb = jax.tree_util.tree_map(jax.device_put, b,
+                                seq_batch_shardings(mesh, b))
+    txt = tr.train_step.lower(
+        sp, so, sb, 0, jax.random.PRNGKey(0)).compile().as_text()
+    return tr.train_net, txt
+
+
+def _qkv(b=2, h=4, s=256, d=16):
+    return tuple(jnp.asarray(RNG.standard_normal((b, h, s, d))
+                             .astype(np.float32)) for _ in range(3))
+
+
+def test_ring_ppermute_counts():
+    """One ring layer over nseq=4: k and v each hop nseq-1 times in the
+    forward — 2*(nseq-1) collective-permutes — and the backward mirrors
+    them exactly (4*(nseq-1) total under grad)."""
+    nseq = 4
+    mesh = make_mesh(seq=nseq, data=2)
+    q, k, v = _qkv()
+    fwd = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, mesh, "seq", True)).lower(q, k, v).compile().as_text()
+    assert len(collective_defs(fwd, "collective-permute")) \
+        == 2 * (nseq - 1)
+    assert not collective_defs(fwd, "all-gather")
+
+    grad = jax.jit(jax.grad(
+        lambda q, k, v: ring_attention(q, k, v, mesh, "seq", True).sum(),
+        argnums=(0, 1, 2))).lower(q, k, v).compile().as_text()
+    assert len(collective_defs(grad, "collective-permute")) \
+        == 4 * (nseq - 1)
+    assert not collective_defs(grad, "all-gather")
+
+
+def test_ulysses_all_to_all_present_no_gather():
+    """Ulysses moves data exclusively through all-to-alls (q, k, v in +
+    out back): they must appear, and nothing may fall back to an
+    all-gather of the full sequence."""
+    mesh = make_mesh(seq=4, data=2)
+    q, k, v = _qkv()
+    txt = jax.jit(lambda q, k, v: ulysses_attention(
+        q, k, v, mesh, "seq", True)).lower(q, k, v).compile().as_text()
+    assert len(collective_defs(txt, "all-to-all")) >= 4
+    assert not collective_defs(txt, "all-gather")
+
+
+def test_dp_step_psums_grads_only():
+    """Pure DP: gradient all-reduces and nothing else — no gathers, no
+    permutes (a gather here would mean a param or activation silently
+    replicating through comm)."""
+    mesh = make_mesh(data=8)
+    cfg = transformer_lm(vocab_size=64, num_layers=1, embed_dim=64,
+                         num_heads=4, head_dim=16, seq_len=128,
+                         batchsize=8)
+    _, txt = _sharded_step_text(mesh, cfg, 8, 128)
+    assert collective_defs(txt, "all-reduce")
+    assert not collective_defs(txt, "all-gather")
+    assert not collective_defs(txt, "collective-permute")
+
+
+def test_tp_step_never_gathers_full_params():
+    """dp×tp: activation boundary gathers are the Megatron contract,
+    but NO all-gather may produce a full parameter (that would mean the
+    sharded weight reassembles every step)."""
+    mesh = make_mesh(data=4, model=2)
+    cfg = transformer_lm(vocab_size=64, num_layers=1, embed_dim=64,
+                         num_heads=4, head_dim=16, seq_len=128,
+                         batchsize=8)
+    net, txt = _sharded_step_text(mesh, cfg, 8, 128)
+    param_shapes = {tuple(s.shape) for s in net.param_specs.values()}
+    for dtype, dims in collective_defs(txt, "all-gather"):
+        shape = tuple(int(x) for x in dims.split(",") if x)
+        assert shape not in param_shapes, (
+            f"all-gather reassembles full param shape {shape}")
+
+
+def test_ring_sp_step_has_no_full_sequence_gather():
+    """The SP train step must keep EVERY tensor sequence-sharded: zero
+    all-gathers in the lowered step.  Regression guard for the round-5
+    find that the loss's (B,S,E)→(B·S,E) reshape gathered the full
+    sequence per data shard before _shard_tokens pinned the merged
+    token dim to ("data","seq")."""
+    nseq = 4
+    mesh = make_mesh(data=2, seq=nseq)
+    cfg = transformer_lm(vocab_size=64, num_layers=1, embed_dim=64,
+                         num_heads=4, head_dim=16, seq_len=128,
+                         batchsize=8, seq_parallel="ring")
+    _, txt = _sharded_step_text(mesh, cfg, 8, 128)
+    assert not collective_defs(txt, "all-gather"), [
+        f"{t}[{d}]" for t, d in collective_defs(txt, "all-gather")]
+    # fwd + bwd ppermutes for one ring layer
+    assert len(collective_defs(txt, "collective-permute")) \
+        == 4 * (nseq - 1)
+    assert collective_defs(txt, "all-reduce")
