@@ -466,9 +466,12 @@ class LMHeadLossLayer(Layer, _HeadProjection):
         the (B, S, E)→(B·S, E) reshape under sequence parallelism by
         ALL-GATHERING the full sequence per data shard (observed in
         lowered HLO: an f32[B/dp, S, E] gather) — which defeats the
-        O(S/n) activation memory SP exists for.  The merge is exact: B
-        rides "data" major, S rides "seq" minor, so the merged dim
-        shards over the axis product with no data movement."""
+        O(S/n) activation memory SP exists for.  NOT free: the merged
+        row order is b-major, so the ("data","seq") tiling differs from
+        the source (b-block, s-block) tiles and GSPMD inserts an
+        all-to-all reshard (visible in lowered HLO) costing O(local
+        bytes) over ICI per step — the bounded price for never
+        materializing full-S activations."""
         if ctx.mesh is None:
             return h2, l2
         from jax.sharding import NamedSharding, PartitionSpec as P

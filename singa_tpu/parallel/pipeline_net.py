@@ -129,15 +129,27 @@ def _external_input(net: NeuralNet, stage: List[str]) -> str:
 
 
 def _check_mesh(pnet, mesh, axis):
+    """Returns the interleave factor v = n_stages / pipe size.  v == 1
+    is the plain GPipe schedule (one stage per device); v > 1 — only
+    for the uniform PipelineNet — selects the circular/interleaved
+    schedule (device d runs stages d, d+P, …), which cuts the bubble
+    ~v× (pipeline.py _schedule_circular)."""
     if mesh is None or axis not in mesh.shape:
         raise PipelineError(f"{type(pnet).__name__}.apply needs a mesh "
                             f"with a {axis!r} axis")
-    if mesh.shape[axis] != pnet.n_stages:
-        # the schedule holds exactly one stage per pipe row; a
-        # mismatch would silently drop stages
+    p = mesh.shape[axis]
+    if pnet.n_stages % p:
+        # a non-multiple would silently drop stages
         raise PipelineError(
-            f"{pnet.n_stages} locationid stages need pipe axis of "
-            f"the same size, mesh has {axis}={mesh.shape[axis]}")
+            f"{pnet.n_stages} locationid stages need a pipe axis that "
+            f"divides them, mesh has {axis}={p}")
+    v = pnet.n_stages // p
+    if v > 1 and not getattr(pnet, "supports_interleave", False):
+        raise PipelineError(
+            f"{pnet.n_stages} stages on {axis}={p} needs the "
+            f"interleaved schedule, which {type(pnet).__name__} does "
+            f"not support — use equal stage/axis counts")
+    return v
 
 
 def _pre_apply(pnet, params, batch, rng, train, mesh, compute_dtype,
@@ -329,6 +341,8 @@ class HeteroPipelineNet:
 class PipelineNet:
     """Pipelined evaluator over a built NeuralNet (see module doc)."""
 
+    supports_interleave = True
+
     def __init__(self, net: NeuralNet, n_micro: int):
         self.net = net
         self.n_micro = n_micro
@@ -379,7 +393,7 @@ class PipelineNet:
         The pre/post groups run through NeuralNet.apply(layer_subset=…)
         so their per-layer semantics (fuse_from, remat, aux losses)
         stay identical to the unpipelined net."""
-        _check_mesh(self, mesh, axis)
+        virtual = _check_mesh(self, mesh, axis)
         outputs: Dict[str, Any] = {}
         metrics: Dict[str, jnp.ndarray] = {}
         train, total_loss, x = _pre_apply(
@@ -411,7 +425,7 @@ class PipelineNet:
         y = pipeline_apply(
             mesh, stage_fn, stacked, xm, axis=axis,
             batch_axis=_data_batch_axis(mesh, b // self.n_micro),
-            rng=_stage_rng(rng, train))
+            rng=_stage_rng(rng, train), virtual=virtual)
         last_out = self.stages[-1][-1]
         outputs[last_out] = y.reshape((b,) + y.shape[2:])
         return _post_apply(self, params, batch, rng, train, mesh,

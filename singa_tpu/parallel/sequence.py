@@ -102,7 +102,6 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
     tp = mesh.shape["model"]
     head_axis = "model" if h % tp == 0 and hkv % tp == 0 else None
     spec = P("data", head_axis, axis, None)
-    kv_spec = spec
     chunk = s_global // nseq
     if use_flash is None:
         use_flash = flash_chunk_legal(chunk, chunk, d)
@@ -120,30 +119,33 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
         lse = jnp.full(q.shape[:3] + (1,), NEG_INF, jnp.float32)
         k_cur, v_cur = k, v
         for s in range(nseq):
-            # group-expand the LOCAL chunk only; the rotating carry
-            # stays at Hkv width
-            ke = expand_kv_heads(k_cur, h_local)
-            ve = expand_kv_heads(v_cur, h_local)
+            # group expansion happens on the LOCAL chunk only (the
+            # rotating carry stays at Hkv width), and for the
+            # conditional rotations INSIDE the visible branch so
+            # fully-masked hops do no attention-side work at all
+            def vis(args, causal_=False):
+                qq, kk, vv = args
+                return flash_chunk(qq, expand_kv_heads(kk, h_local),
+                                   expand_kv_heads(vv, h_local),
+                                   causal_, block_q=fbq, block_k=fbk)
+
             if not causal:
-                o_new, l_new = flash_chunk(q, ke, ve, False,
-                                           block_q=fbq, block_k=fbk)
+                o_new, l_new = vis((q, k_cur, v_cur))
             elif s == 0:
                 # diagonal: kv_off == q_off on every device
-                o_new, l_new = flash_chunk(q, ke, ve, True,
-                                           block_q=fbq, block_k=fbk)
+                o_new, l_new = vis((q, k_cur, v_cur), True)
             else:
                 # kv chunk s hops back: visible iff it wrapped no ring
                 # boundary (idx >= s); otherwise it is entirely in the
                 # future and contributes nothing
                 o_new, l_new = jax.lax.cond(
                     idx >= s,
-                    lambda args: flash_chunk(*args, False,
-                                             block_q=fbq, block_k=fbk),
+                    vis,
                     lambda args: (
                         jnp.zeros(args[0].shape, jnp.float32),
                         jnp.full(args[0].shape[:3] + (1,), NEG_INF,
                                  jnp.float32)),
-                    (q, ke, ve))
+                    (q, k_cur, v_cur))
             out, lse = merge_attention(out, lse, o_new, l_new)
             if s < nseq - 1:
                 k_cur = jax.lax.ppermute(k_cur, axis, perm)
@@ -179,7 +181,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
         return out.astype(q.dtype)
 
     return shard_map(local_flash if use_flash else local, mesh=mesh,
-                     in_specs=(spec, kv_spec, kv_spec),
+                     in_specs=(spec, spec, spec),
                      out_specs=spec, check_vma=False)(q, k, v)
 
 
