@@ -225,6 +225,67 @@ def bench_transformer_mfu(batch_size=32, seq_len=1024, iters=30,
             "model_tflops_per_step": round(flops / 1e12, 4)}
 
 
+def bench_decode(batch_size=8, prompt_len=128, new_tokens=256,
+                 reps=3, precision="bfloat16"):
+    """KV-cache decode throughput: tokens/sec across the batch for the
+    bench transformer (12L 768E 32k vocab), greedy sampling, one
+    compiled prefill+scan program (models/generate.py).  vs_baseline is
+    tokens/sec per chip over the batch — there is no reference decode
+    path to compare against (the reference is train/test only), so the
+    row exists to make inference regressions visible round over round
+    (BASELINE.md "Decode path")."""
+    import jax
+
+    from singa_tpu.core.trainer import Trainer
+    from singa_tpu.models.generate import generate
+    from singa_tpu.models.transformer import transformer_lm
+    from singa_tpu.utils.profiler import hard_sync
+
+    seq = prompt_len + new_tokens
+    cfg = transformer_lm(vocab_size=32768, num_layers=12, embed_dim=768,
+                         num_heads=12, head_dim=64, seq_len=seq,
+                         batchsize=batch_size)
+    cfg.precision = precision
+    trainer = Trainer(cfg, {"data": {"input": (seq,), "target": (seq,)}},
+                      log_fn=lambda s: None)
+    net = trainer.test_net or trainer.train_net
+    params, _ = trainer.init(seed=0)
+    if precision == "bfloat16":
+        import jax.numpy as jnp
+        params = {k: v.astype(jnp.bfloat16) for k, v in params.items()}
+    rng = np.random.default_rng(0)
+    prompt = jax.device_put(rng.integers(
+        0, 32768, (batch_size, prompt_len)).astype(np.int32))
+
+    def timed(n_new):
+        out = generate(net, params, prompt, n_new)   # compile + warm
+        hard_sync(out)
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = generate(net, params, prompt, n_new)
+            hard_sync(out)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    # prefill isolated via a 1-new-token run so the per-decode-step
+    # number tracks the decode path only (a prefill-only speedup must
+    # not move the decode regression anchor)
+    t_full, t_prefill = timed(new_tokens), timed(1)
+    decode_s = max(t_full - t_prefill, 1e-9) / (new_tokens - 1)
+    tok_sec = batch_size / decode_s
+    return {"metric": "decode_tok_sec",
+            "value": round(tok_sec, 1),
+            "unit": "tokens/sec/chip",
+            "batch": batch_size, "prompt_len": prompt_len,
+            "new_tokens": new_tokens,
+            "ms_per_decode_step": round(decode_s * 1e3, 3),
+            "prefill_ms": round(t_prefill * 1e3, 3),
+            "end_to_end_tok_sec": round(
+                batch_size * new_tokens / t_full, 1),
+            "precision": precision}
+
+
 def _convergence_aux():
     path = os.path.join(REPO, "CONVERGENCE.json")
     if not os.path.exists(path):
@@ -279,7 +340,7 @@ def main() -> None:
     if "--extra" in sys.argv:
         # transformer MFU is not repeated here: main() already ran it
         # for the primary line's aux keys
-        for fn in (bench_lenet, bench_quick_mfu):
+        for fn in (bench_lenet, bench_quick_mfu, bench_decode):
             try:
                 print(json.dumps(fn()), file=sys.stderr)
             except Exception as e:  # secondary metrics must not break

@@ -200,14 +200,50 @@ class NeuralNet:
             out, NamedSharding(mesh, P(*spec)))
 
     def _resolve_params(self, params: Dict[str, jnp.ndarray]):
-        if not self.param_aliases:
-            return params
         full = dict(params)
+        # padded storage (parallel/partition.py pad_params): an array
+        # larger than its spec in the PARTITION dim (and exact in every
+        # other dim — anything else is a config mismatch that must keep
+        # failing loudly) carries a pad-to-divisible tail so uneven
+        # dims shard instead of replicating; slice it off at use.  Zero
+        # pad + slice keeps the training closure exact (pad grads are
+        # the slice transpose: zero), so the layout is invisible to
+        # layers and decode; checkpoints are saved UNPADDED
+        # (unpad_params at the save boundary) so they stay
+        # mesh-portable.
+        for name, spec in self.param_specs.items():
+            arr = full.get(name)
+            if arr is None or not hasattr(arr, "shape"):
+                continue
+            d = spec.partition_dim
+            if (d is not None and 0 <= d < len(spec.shape)
+                    and len(arr.shape) == len(spec.shape)
+                    and arr.shape[d] > spec.shape[d]
+                    and all(a == s for i, (a, s) in
+                            enumerate(zip(arr.shape, spec.shape))
+                            if i != d)):
+                full[name] = jax.lax.slice(
+                    arr, (0,) * len(spec.shape), spec.shape)
         for alias, owner in self.param_aliases.items():
             if owner not in full:
                 raise LayerError(f"share_param target {owner!r} not found")
             full[alias] = full[owner]
         return full
+
+    def unpad_params(self, params: Dict[str, jnp.ndarray]):
+        """Slice padded-storage params (see _resolve_params) back to
+        their spec shapes — used at the checkpoint save boundary so
+        checkpoints stay spec-shaped and mesh-portable (a restore under
+        a different mesh, or none, re-pads via shard_params)."""
+        out = {}
+        for name, arr in params.items():
+            spec = self.param_specs.get(name)
+            if (spec is not None and hasattr(arr, "shape")
+                    and tuple(arr.shape) != tuple(spec.shape)
+                    and len(arr.shape) == len(spec.shape)):
+                arr = arr[tuple(slice(0, s) for s in spec.shape)]
+            out[name] = arr
+        return out
 
     def _constrain_uneven_params(self, full, mesh):
         """Partition the COMPUTE on params whose partition dim doesn't

@@ -573,7 +573,7 @@ class Trainer:
             if interrupted:
                 self.log(f"signal {interrupted[0]} received: checkpointing "
                          f"at step {step} and stopping")
-                ckpt.save(step, params, opt_state)
+                ckpt.save(step, *self._ckpt_state(params, opt_state))
                 break
             if self.val_step and self.validate_now(step) and val_iter_factory:
                 avg = self.evaluate(params, val_iter_factory(),
@@ -657,13 +657,22 @@ class Trainer:
             if (ckpt is not None and self.cfg.checkpoint_frequency > 0
                     and last >= self.cfg.checkpoint_after_steps
                     and (last + 1) % self.cfg.checkpoint_frequency == 0):
-                ckpt.save(last + 1, params, opt_state)
+                ckpt.save(last + 1, *self._ckpt_state(params, opt_state))
             step += n
         self._ckpt_unguard(old_handlers)
         if (ckpt is not None and not interrupted
                 and self.cfg.train_steps > start_step):
-            ckpt.save(self.cfg.train_steps, params, opt_state)
+            ckpt.save(self.cfg.train_steps, *self._ckpt_state(params, opt_state))
         return params, opt_state, history
+
+    def _ckpt_state(self, params, opt_state):
+        """Checkpoint payload: padded-storage params/opt state (uneven
+        partition dims, parallel/partition.py pad_params) sliced back
+        to spec shapes so checkpoints stay mesh-portable — a restore
+        under any mesh (or none) re-pads via shard_params."""
+        net = self.train_net
+        return (net.unpad_params(params),
+                {k: net.unpad_params(t) for k, t in opt_state.items()})
 
     def _ckpt_guard(self, workspace):
         """(ckpt_manager, interrupted, old_handlers) — the shared
@@ -774,7 +783,7 @@ class Trainer:
             if interrupted:
                 self.log(f"signal {interrupted[0]} received: "
                          f"checkpointing at step {step} and stopping")
-                ckpt.save(step, params, opt_state)
+                ckpt.save(step, *self._ckpt_state(params, opt_state))
                 break
             if (self.test_step and self.test_now(step)
                     and test_iter_factory):
@@ -810,10 +819,10 @@ class Trainer:
             if (ckpt is not None and self.cfg.checkpoint_frequency > 0
                     and step >= self.cfg.checkpoint_after_steps
                     and (step + 1) % self.cfg.checkpoint_frequency == 0):
-                ckpt.save(step + 1, params, opt_state)
+                ckpt.save(step + 1, *self._ckpt_state(params, opt_state))
         self._ckpt_unguard(old_handlers)
         if ckpt is not None and not interrupted and total > start_step:
-            ckpt.save(total, params, opt_state)
+            ckpt.save(total, *self._ckpt_state(params, opt_state))
         return params, opt_state, history
 
     def resume(self, params, opt_state, workspace: str):

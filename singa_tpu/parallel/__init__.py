@@ -7,7 +7,7 @@ only needed when a model actually uses them.
 from .bootstrap import (coordinator_address, distributed_init,
                         parse_hostfile)
 from .mesh import AXES, make_mesh, mesh_from_cluster
-from .partition import (param_shardings, batch_shardings,
+from .partition import (param_shardings, batch_shardings, pad_params,
                         seq_batch_shardings, shard_params,
                         shard_opt_state, shard_batch, replicated)
 

@@ -196,3 +196,109 @@ def test_indivisible_batch_partition_matches_unpartitioned():
     for k in g0:
         np.testing.assert_allclose(np.asarray(g0[k]), np.asarray(g1[k]),
                                    rtol=1e-4, atol=1e-6, err_msg=k)
+
+
+def test_uneven_param_storage_shards_with_padding():
+    """Round-5 close of the storage gap: shard_params pads the 10-wide
+    fc_dst weight to 12 and SHARDS it over model=4 (3 columns per
+    device instead of a replicated 10), optimizer state follows, and a
+    full sharded train step still reproduces unsharded numerics."""
+    from singa_tpu.core.trainer import Trainer
+    from singa_tpu.parallel import shard_opt_state, shard_params
+
+    mesh = make_mesh(jax.devices(), data=2, model=4)
+    cfg = _cfg("kNone", "kLayerPartition")
+    cfg.neuralnet.layer[5].inner_product_param.num_output = 10
+    tr_flat = Trainer(cfg, SHAPES, donate=False)
+    tr_mesh = Trainer(cfg, SHAPES, donate=False, mesh=mesh)
+    params, opt = tr_flat.init(0)
+    batch = _batch(np.random.default_rng(3))
+    rng = jax.random.PRNGKey(0)
+    p0, o0, m0 = tr_flat.train_step(params, opt, batch, 0, rng)
+
+    sp = shard_params(mesh, tr_mesh.train_net, params)
+    so = shard_opt_state(mesh, tr_mesh.train_net, opt)
+    # find the fc_dst weight: logical (·, 10), stored (·, 12) sharded
+    wname = [n for n, s in tr_mesh.train_net.param_specs.items()
+             if s.shape[-1] == 10 and len(s.shape) == 2][0]
+    assert sp[wname].shape[-1] == 12
+    shard_shapes = {tuple(s.data.shape)
+                    for s in sp[wname].addressable_shards}
+    assert all(sh[-1] == 3 for sh in shard_shapes), shard_shapes
+    # optimizer state shards identically
+    for tree in so.values():
+        if wname in tree:
+            assert tree[wname].shape[-1] == 12
+            assert all(tuple(s.data.shape)[-1] == 3
+                       for s in tree[wname].addressable_shards)
+
+    sb = shard_batch(mesh, batch)
+    p1, o1, m1 = tr_mesh.train_step(sp, so, sb, 0, rng)
+    assert float(m0["loss"]) == pytest.approx(float(m1["loss"]), rel=1e-5)
+    for k in p0:
+        a1 = np.asarray(p1[k])
+        a0 = np.asarray(p0[k])
+        if a1.shape != a0.shape:        # padded param: compare the body,
+            sl = tuple(slice(0, d) for d in a0.shape)   # pad stays zero
+            np.testing.assert_allclose(
+                a1[tuple(slice(d, None) if i == len(a0.shape) - 1 else
+                         slice(None) for i, d in enumerate(a0.shape))],
+                0.0, atol=1e-7, err_msg=f"{k}: pad region moved")
+            a1 = a1[sl]
+        np.testing.assert_allclose(a0, a1, rtol=1e-4, atol=1e-6,
+                                   err_msg=k)
+
+
+def test_padded_storage_checkpoints_stay_spec_shaped():
+    """Checkpoints must stay mesh-portable: the save boundary slices
+    padded params AND optimizer state back to spec shapes
+    (Trainer._ckpt_state), and pad_params is idempotent so re-sharding
+    an already-padded tree cannot grow it again."""
+    from singa_tpu.core.trainer import Trainer
+    from singa_tpu.parallel import pad_params, shard_opt_state, \
+        shard_params
+
+    mesh = make_mesh(jax.devices(), data=2, model=4)
+    cfg = _cfg("kNone", "kLayerPartition")
+    cfg.neuralnet.layer[5].inner_product_param.num_output = 10
+    tr = Trainer(cfg, SHAPES, donate=False, mesh=mesh)
+    params, opt = tr.init(0)
+    sp = shard_params(mesh, tr.train_net, params)
+    so = shard_opt_state(mesh, tr.train_net, opt)
+    wname = [n for n, s in tr.train_net.param_specs.items()
+             if s.shape[-1] == 10 and len(s.shape) == 2][0]
+    assert sp[wname].shape[-1] == 12
+    # idempotent: a second pad pass must not grow 12 -> 14
+    again = pad_params(mesh, tr.train_net, sp)
+    assert again[wname].shape[-1] == 12
+    # the save boundary emits spec shapes for params and opt state
+    cp, co = tr._ckpt_state(sp, so)
+    for name, spec in tr.train_net.param_specs.items():
+        assert tuple(cp[name].shape) == tuple(spec.shape), name
+        for tree in co.values():
+            assert tuple(tree[name].shape) == tuple(spec.shape), name
+
+
+def test_resolve_params_rejects_config_mismatch():
+    """_resolve_params only slices partition-dim pad; a checkpoint from
+    a different config (wrong non-partition dim) must keep failing
+    loudly instead of being silently truncated."""
+    import jax.numpy as jnp
+
+    from singa_tpu.core.net import build_net
+
+    cfg = _cfg("kNone", "kLayerPartition")
+    net = build_net(cfg, "kTrain", SHAPES)
+    params = net.init_params(jax.random.PRNGKey(0))
+    wname = [n for n, s in net.param_specs.items()
+             if len(s.shape) == 2][0]
+    spec = net.param_specs[wname]
+    # grow the NON-partition dim: must NOT be sliced away
+    bad = dict(params)
+    bigger = tuple(d + 4 if i != spec.partition_dim else d
+                   for i, d in enumerate(spec.shape))
+    bad[wname] = jnp.zeros(bigger, jnp.float32)
+    resolved = net._resolve_params(bad)
+    assert tuple(resolved[wname].shape) == bigger  # untouched -> layer
+    with pytest.raises(Exception):                 # fails loudly there
+        net.apply(bad, _batch(np.random.default_rng(0)), train=False)
