@@ -230,10 +230,10 @@ def bench_decode(batch_size=8, prompt_len=128, new_tokens=256,
     """KV-cache decode throughput: tokens/sec across the batch for the
     bench transformer (12L 768E 32k vocab), greedy sampling, one
     compiled prefill+scan program (models/generate.py).  vs_baseline is
-    tokens/sec per chip over the batch — there is no reference decode
-    path to compare against (the reference is train/test only), so the
-    row exists to make inference regressions visible round over round
-    (BASELINE.md "Decode path")."""
+    null — there is no reference decode path to compare against (the
+    reference is train/test only); the row exists to make inference
+    regressions visible round over round (BASELINE.md "Decode
+    path")."""
     import jax
 
     from singa_tpu.core.trainer import Trainer
@@ -258,12 +258,17 @@ def bench_decode(batch_size=8, prompt_len=128, new_tokens=256,
         0, 32768, (batch_size, prompt_len)).astype(np.int32))
 
     def timed(n_new):
-        out = generate(net, params, prompt, n_new)   # compile + warm
+        # max_len pins the cache geometry to the full run's, so the
+        # 1-new-token prefill probe runs the IDENTICAL prefill program
+        # (same cache allocation, same masked-dense score width) and
+        # the subtraction isolates exactly the decode steps
+        out = generate(net, params, prompt, n_new,
+                       max_len=seq)                  # compile + warm
         hard_sync(out)
         best = float("inf")
         for _ in range(reps):
             t0 = time.perf_counter()
-            out = generate(net, params, prompt, n_new)
+            out = generate(net, params, prompt, n_new, max_len=seq)
             hard_sync(out)
             best = min(best, time.perf_counter() - t0)
         return best
@@ -277,6 +282,8 @@ def bench_decode(batch_size=8, prompt_len=128, new_tokens=256,
     return {"metric": "decode_tok_sec",
             "value": round(tok_sec, 1),
             "unit": "tokens/sec/chip",
+            "vs_baseline": None,
+
             "batch": batch_size, "prompt_len": prompt_len,
             "new_tokens": new_tokens,
             "ms_per_decode_step": round(decode_s * 1e3, 3),

@@ -25,6 +25,21 @@ from .layers import Context, Layer, LayerError, ParamSpec, create_layer
 from .updater import Multipliers
 
 
+def _pad_excess(spec: ParamSpec, arr) -> bool:
+    """Whether `arr` is `spec` plus a pad-to-divisible tail on the
+    partition dim (parallel/partition.py pad_params) — the ONE shape
+    mismatch that slice-at-use (_resolve_params) and unpad-at-save
+    (unpad_params) agree to absorb.  Anything else is a config mismatch
+    that must keep failing loudly downstream."""
+    d = spec.partition_dim
+    return (d is not None and 0 <= d < len(spec.shape)
+            and len(arr.shape) == len(spec.shape)
+            and arr.shape[d] > spec.shape[d]
+            and all(a == s for i, (a, s) in
+                    enumerate(zip(arr.shape, spec.shape))
+                    if i != d))
+
+
 class NeuralNet:
     def __init__(self, net_cfg: NetConfig, phase: str = "kTrain",
                  input_shapes: Optional[Dict[str, Dict[str, tuple]]] = None,
@@ -215,13 +230,7 @@ class NeuralNet:
             arr = full.get(name)
             if arr is None or not hasattr(arr, "shape"):
                 continue
-            d = spec.partition_dim
-            if (d is not None and 0 <= d < len(spec.shape)
-                    and len(arr.shape) == len(spec.shape)
-                    and arr.shape[d] > spec.shape[d]
-                    and all(a == s for i, (a, s) in
-                            enumerate(zip(arr.shape, spec.shape))
-                            if i != d)):
+            if _pad_excess(spec, arr):
                 full[name] = jax.lax.slice(
                     arr, (0,) * len(spec.shape), spec.shape)
         for alias, owner in self.param_aliases.items():
@@ -233,14 +242,16 @@ class NeuralNet:
     def unpad_params(self, params: Dict[str, jnp.ndarray]):
         """Slice padded-storage params (see _resolve_params) back to
         their spec shapes — used at the checkpoint save boundary so
-        checkpoints stay spec-shaped and mesh-portable (a restore under
-        a different mesh, or none, re-pads via shard_params)."""
+        checkpoints stay spec-shaped and mesh-portable (Trainer.resume
+        re-pads via shard_params).  Only a partition-dim excess is
+        sliced, mirroring _resolve_params: any other shape mismatch is
+        a config error that must keep failing loudly, not be silently
+        cropped into a checkpoint."""
         out = {}
         for name, arr in params.items():
             spec = self.param_specs.get(name)
             if (spec is not None and hasattr(arr, "shape")
-                    and tuple(arr.shape) != tuple(spec.shape)
-                    and len(arr.shape) == len(spec.shape)):
+                    and _pad_excess(spec, arr)):
                 arr = arr[tuple(slice(0, s) for s in spec.shape)]
             out[name] = arr
         return out

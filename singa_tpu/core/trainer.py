@@ -827,10 +827,25 @@ class Trainer:
 
     def resume(self, params, opt_state, workspace: str):
         """Restore the latest snapshot (Worker::Resume, finally real).
-        Returns (params, opt_state, start_step)."""
+        Returns (params, opt_state, start_step).
+
+        Checkpoints are saved spec-shaped (_ckpt_state unpads the
+        pad-to-divisible storage of uneven partition dims), so the
+        restore template must be spec-shaped too — the caller may hand
+        us padded, sharded live arrays (main.py resumes AFTER
+        shard_params).  After the restore, re-pad + re-shard under the
+        trainer's mesh so the padded sharded layout survives a
+        resume."""
         from ..utils.checkpoint import CheckpointManager
+        net = self.train_net
+        tpl_p, tpl_o = self._ckpt_state(params, opt_state)
         restored = CheckpointManager(workspace).restore(
-            template={"params": params, "opt_state": opt_state})
+            template={"params": tpl_p, "opt_state": tpl_o})
         if restored is None:
             return params, opt_state, 0
-        return restored
+        rp, ro, step = restored
+        if self.mesh is not None:
+            from ..parallel import shard_opt_state, shard_params
+            rp = shard_params(self.mesh, net, rp)
+            ro = shard_opt_state(self.mesh, net, ro)
+        return rp, ro, step

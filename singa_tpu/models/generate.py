@@ -54,9 +54,13 @@ def init_cache(net: NeuralNet, batchsize: int, max_len: int,
 def _attn_cached(layer, params, x, entry: CacheEntry, pos
                  ) -> Tuple[jnp.ndarray, CacheEntry]:
     """Attention for a (B, T, E) chunk whose first token sits at absolute
-    position `pos` (traced scalar), against the running KV cache."""
-    from ..ops.attention import expand_kv_heads
+    position `pos` (traced scalar), against the running KV cache.
 
+    GQA reads the cache at Hkv width: q is grouped to (B, Hkv, G, T, D)
+    and contracted against the (B, Hkv, max_len, D) cache directly — no
+    expand_kv_heads copy, so the per-step HBM cache read (the decode
+    bottleneck once weights are amortized over batch) scales with Hkv,
+    not H."""
     assert layer.causal, f"{layer.name}: decode requires causal attention"
     b, t, e = x.shape
     q, k, v = layer.qkv(params, x, pos + jnp.arange(t), _CTX)
@@ -66,16 +70,19 @@ def _attn_cached(layer, params, x, entry: CacheEntry, pos
     v_cache = jax.lax.dynamic_update_slice(
         entry["v"], v.astype(entry["v"].dtype), (0, 0, pos, 0))
 
-    kk = expand_kv_heads(k_cache, layer.heads).astype(q.dtype)
-    vv = expand_kv_heads(v_cache, layer.heads).astype(q.dtype)
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, kk,
+    groups = layer.heads // layer.kv_heads
+    qg = q.reshape(b, layer.kv_heads, groups, t, layer.head_dim)
+    kk = k_cache.astype(q.dtype)
+    vv = v_cache.astype(q.dtype)
+    scores = jnp.einsum("bhgqd,bhkd->bhgqk", qg, kk,
                         preferred_element_type=jnp.float32)
     scores = scores / jnp.sqrt(jnp.float32(layer.head_dim))
     qpos = pos + jnp.arange(t)[:, None]            # (T, 1) absolute
     kpos = jnp.arange(kk.shape[2])[None, :]        # (1, max_len)
-    scores = jnp.where((kpos <= qpos)[None, None], scores, -1e30)
+    scores = jnp.where((kpos <= qpos)[None, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vv.dtype), vv)
+    out = jnp.einsum("bhgqk,bhkd->bhgqd", probs.astype(vv.dtype), vv)
+    out = out.reshape(b, layer.heads, t, layer.head_dim)
     out = out.transpose(0, 2, 1, 3).reshape(b, t, -1)
     out = layer._proj(params, layer.wo, out.astype(x.dtype), _CTX)
     return out, {"k": k_cache, "v": v_cache}
@@ -135,11 +142,11 @@ def _sample(logits: jnp.ndarray, key, temperature: float,
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnums=(0, 3, 5, 6, 7))
+@partial(jax.jit, static_argnums=(0, 3, 5, 6, 7, 8))
 def _generate_jit(net, params, prompt, max_new_tokens, key,
-                  temperature, top_k, eos_id):
+                  temperature, top_k, eos_id, max_len):
     b, p = prompt.shape
-    max_len = p + max_new_tokens
+    max_len = max(max_len or 0, p + max_new_tokens)
     dtype = jax.tree_util.tree_leaves(params)[0].dtype
     cache = init_cache(net, b, max_len, dtype)
 
@@ -166,16 +173,21 @@ def _generate_jit(net, params, prompt, max_new_tokens, key,
 def generate(net: NeuralNet, params, prompt,
              max_new_tokens: int, key: Optional[jax.Array] = None,
              temperature: float = 0.0, top_k: int = 0,
-             eos_id: Optional[int] = None) -> jnp.ndarray:
+             eos_id: Optional[int] = None,
+             max_len: Optional[int] = None) -> jnp.ndarray:
     """Sample `max_new_tokens` continuations of `prompt` ((B, P) int32).
     Returns the (B, max_new_tokens) generated tokens.  One compiled
     program: prefill + a lax.scan decode loop with per-step sampling
     (greedy when temperature == 0; top-k truncation when top_k > 0).
-    After `eos_id` is produced, a sequence keeps emitting `eos_id`."""
+    After `eos_id` is produced, a sequence keeps emitting `eos_id`.
+    `max_len` over-allocates the KV cache beyond prompt+new (the tail
+    is mask-ignored) — lets callers fix the cache geometry across runs
+    of different lengths (bench.py isolates prefill this way)."""
     if key is None:
         key = jax.random.PRNGKey(0)
     prompt = jnp.asarray(prompt, jnp.int32)
     if int(max_new_tokens) <= 0:
         return jnp.zeros((prompt.shape[0], 0), jnp.int32)
     return _generate_jit(net, params, prompt, int(max_new_tokens), key,
-                         float(temperature), int(top_k), eos_id)
+                         float(temperature), int(top_k), eos_id,
+                         None if max_len is None else int(max_len))

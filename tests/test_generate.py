@@ -142,3 +142,15 @@ def test_generate_with_moe_and_gqa():
                                rtol=2e-4, atol=2e-4)
     out = generate(net, params, toks[:, :4], 6)
     assert out.shape == (B, 6)
+
+
+def test_generate_max_len_overallocation_equivalent():
+    """An over-allocated KV cache (max_len > prompt+new) must not change
+    the tokens: the tail slots are mask-ignored.  bench.py relies on
+    this to time the prefill probe at the full run's cache geometry."""
+    net, params = _net_and_params(False)
+    toks = jnp.asarray(
+        np.random.default_rng(5).integers(0, VOCAB, (B, 6)), jnp.int32)
+    base = generate(net, params, toks, 8)
+    over = generate(net, params, toks, 8, max_len=32)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(over))

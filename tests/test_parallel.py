@@ -336,6 +336,16 @@ def test_multihost_sharded_checkpoint_save_restore(tmp_path):
                                  key=lambda s: s.index)])
             np.testing.assert_array_equal(got, want)
         assert ro["momentum"]["w"].sharding == opt["momentum"]["w"].sharding
+
+        # unpad-at-save (net.unpad_params) on a multi-process mesh: an
+        # eager partition-dim slice of a non-fully-addressable padded
+        # param is a collective SPMD computation every process runs —
+        # it must work, not raise, so padded-storage checkpointing
+        # composes with multi-host training
+        padded = make((8, 6), P("data", "model"), 5)
+        sliced = padded[:, :5]
+        jax.block_until_ready(sliced)
+        assert sliced.shape == (8, 5)
         print(f"proc{pid} sharded_ckpt_ok step={step}", flush=True)
     """))
 

@@ -302,3 +302,70 @@ def test_resolve_params_rejects_config_mismatch():
     assert tuple(resolved[wname].shape) == bigger  # untouched -> layer
     with pytest.raises(Exception):                 # fails loudly there
         net.apply(bad, _batch(np.random.default_rng(0)), train=False)
+
+
+def test_resume_under_padded_mesh_roundtrips(tmp_path):
+    """--resume with pad-to-divisible sharded storage: main.py resumes
+    AFTER shard_params, so Trainer.resume receives a PADDED template
+    while checkpoints are saved spec-shaped (_ckpt_state).  resume must
+    unpad the template for the restore, then re-pad + re-shard under
+    the trainer's mesh so the padded sharded layout survives."""
+    from singa_tpu.core.trainer import Trainer
+    from singa_tpu.parallel import shard_opt_state, shard_params
+    from singa_tpu.utils.checkpoint import CheckpointManager
+
+    mesh = make_mesh(jax.devices(), data=2, model=4)
+    cfg = _cfg("kNone", "kLayerPartition")
+    cfg.neuralnet.layer[5].inner_product_param.num_output = 10
+    tr = Trainer(cfg, SHAPES, donate=False, mesh=mesh)
+    params, opt = tr.init(0)
+    sp = shard_params(mesh, tr.train_net, params)
+    so = shard_opt_state(mesh, tr.train_net, opt)
+    CheckpointManager(str(tmp_path)).save(5, *tr._ckpt_state(sp, so))
+
+    rp, ro, step = tr.resume(sp, so, str(tmp_path))
+    assert step == 5
+    wname = [n for n, s in tr.train_net.param_specs.items()
+             if s.shape[-1] == 10 and len(s.shape) == 2][0]
+    # restored storage is padded AND sharded again (3 columns/device)
+    assert rp[wname].shape[-1] == 12
+    assert all(tuple(s.data.shape)[-1] == 3
+               for s in rp[wname].addressable_shards)
+    for tree in ro.values():
+        if wname in tree:
+            assert tree[wname].shape[-1] == 12
+    # values round-trip exactly (body of the padded arrays)
+    for k, spec in tr.train_net.param_specs.items():
+        body = np.asarray(rp[k])[tuple(slice(0, d) for d in spec.shape)]
+        np.testing.assert_array_equal(body, np.asarray(params[k]), err_msg=k)
+
+
+def test_unpad_params_keeps_non_partition_mismatch_loud():
+    """unpad_params (the checkpoint save boundary) slices ONLY a
+    partition-dim excess; an array oversized in a non-partition dim —
+    a config mismatch — must pass through untouched so the save fails
+    loudly downstream instead of writing a silently-cropped
+    checkpoint."""
+    import jax.numpy as jnp
+
+    from singa_tpu.core.net import build_net
+
+    cfg = _cfg("kNone", "kLayerPartition")
+    net = build_net(cfg, "kTrain", SHAPES)
+    params = net.init_params(jax.random.PRNGKey(0))
+    wname = [n for n, s in net.param_specs.items()
+             if len(s.shape) == 2][0]
+    spec = net.param_specs[wname]
+    bad = dict(params)
+    bigger = tuple(d + 4 if i != spec.partition_dim else d
+                   for i, d in enumerate(spec.shape))
+    bad[wname] = jnp.zeros(bigger, jnp.float32)
+    out = net.unpad_params(bad)
+    assert tuple(out[wname].shape) == bigger       # NOT cropped
+    # while a genuine partition-dim pad IS sliced off
+    padded = dict(params)
+    wider = tuple(d + 2 if i == spec.partition_dim else d
+                  for i, d in enumerate(spec.shape))
+    padded[wname] = jnp.zeros(wider, jnp.float32)
+    assert tuple(net.unpad_params(padded)[wname].shape) \
+        == tuple(spec.shape)
