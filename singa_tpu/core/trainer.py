@@ -836,9 +836,40 @@ class Trainer:
         shard_params).  After the restore, re-pad + re-shard under the
         trainer's mesh so the padded sharded layout survives a
         resume."""
+        import jax
+
         from ..utils.checkpoint import CheckpointManager
         net = self.train_net
-        tpl_p, tpl_o = self._ckpt_state(params, opt_state)
+        # abstract template: checkpoint-shaped (spec, unpadded) leaves
+        # WITHOUT materializing sliced copies of the live state — at
+        # restore time the live padded arrays, a concrete template, and
+        # the restored arrays would otherwise coexist.  Each leaf
+        # carries an explicit sharding (the live array's where the
+        # shapes match; replicated for pad-sliced leaves, re-sharded
+        # below) so the restore never depends on the sharding recorded
+        # in the checkpoint — which may come from a different topology.
+        tpl_p, tpl_o = jax.eval_shape(self._ckpt_state, params, opt_state)
+
+        def shard_tpl(tpl, live):
+            rep = None
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+                rep = NamedSharding(self.mesh, PartitionSpec())
+            out = {}
+            for k, t in tpl.items():
+                arr = live.get(k)
+                sh = (arr.sharding
+                      if (hasattr(arr, "sharding")
+                          and tuple(arr.shape) == tuple(t.shape))
+                      else rep)
+                out[k] = (jax.ShapeDtypeStruct(t.shape, t.dtype,
+                                               sharding=sh)
+                          if sh is not None else t)
+            return out
+
+        tpl_p = shard_tpl(tpl_p, params)
+        tpl_o = {k: shard_tpl(t, opt_state.get(k, {}))
+                 for k, t in tpl_o.items()}
         restored = CheckpointManager(workspace).restore(
             template={"params": tpl_p, "opt_state": tpl_o})
         if restored is None:

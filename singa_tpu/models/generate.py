@@ -71,18 +71,27 @@ def _attn_cached(layer, params, x, entry: CacheEntry, pos
         entry["v"], v.astype(entry["v"].dtype), (0, 0, pos, 0))
 
     groups = layer.heads // layer.kv_heads
-    qg = q.reshape(b, layer.kv_heads, groups, t, layer.head_dim)
     kk = k_cache.astype(q.dtype)
     vv = v_cache.astype(q.dtype)
-    scores = jnp.einsum("bhgqd,bhkd->bhgqk", qg, kk,
-                        preferred_element_type=jnp.float32)
-    scores = scores / jnp.sqrt(jnp.float32(layer.head_dim))
     qpos = pos + jnp.arange(t)[:, None]            # (T, 1) absolute
     kpos = jnp.arange(kk.shape[2])[None, :]        # (1, max_len)
-    scores = jnp.where((kpos <= qpos)[None, None, None], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhgqk,bhkd->bhgqd", probs.astype(vv.dtype), vv)
-    out = out.reshape(b, layer.heads, t, layer.head_dim)
+    if groups == 1:
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, kk,
+                            preferred_element_type=jnp.float32)
+        scores = scores / jnp.sqrt(jnp.float32(layer.head_dim))
+        scores = jnp.where((kpos <= qpos)[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vv.dtype), vv)
+    else:
+        qg = q.reshape(b, layer.kv_heads, groups, t, layer.head_dim)
+        scores = jnp.einsum("bhgqd,bhkd->bhgqk", qg, kk,
+                            preferred_element_type=jnp.float32)
+        scores = scores / jnp.sqrt(jnp.float32(layer.head_dim))
+        scores = jnp.where((kpos <= qpos)[None, None, None], scores,
+                           -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhgqk,bhkd->bhgqd", probs.astype(vv.dtype), vv)
+        out = out.reshape(b, layer.heads, t, layer.head_dim)
     out = out.transpose(0, 2, 1, 3).reshape(b, t, -1)
     out = layer._proj(params, layer.wo, out.astype(x.dtype), _CTX)
     return out, {"k": k_cache, "v": v_cache}
@@ -146,7 +155,13 @@ def _sample(logits: jnp.ndarray, key, temperature: float,
 def _generate_jit(net, params, prompt, max_new_tokens, key,
                   temperature, top_k, eos_id, max_len):
     b, p = prompt.shape
-    max_len = max(max_len or 0, p + max_new_tokens)
+    if max_len is None:
+        max_len = p + max_new_tokens
+    elif max_len < p + max_new_tokens:
+        # clamping up silently would recompile a different cache
+        # geometry — the exact drift max_len exists to prevent
+        raise ValueError(f"max_len={max_len} < prompt({p}) + "
+                         f"max_new_tokens({max_new_tokens})")
     dtype = jax.tree_util.tree_leaves(params)[0].dtype
     cache = init_cache(net, b, max_len, dtype)
 

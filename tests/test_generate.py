@@ -154,3 +154,13 @@ def test_generate_max_len_overallocation_equivalent():
     base = generate(net, params, toks, 8)
     over = generate(net, params, toks, 8, max_len=32)
     np.testing.assert_array_equal(np.asarray(base), np.asarray(over))
+
+
+def test_generate_max_len_too_small_raises():
+    """max_len below prompt+new must fail loudly — silently clamping up
+    would recompile a different cache geometry, the drift the pin
+    exists to prevent."""
+    net, params = _net_and_params(False)
+    toks = jnp.zeros((B, 6), jnp.int32)
+    with pytest.raises(ValueError, match="max_len"):
+        generate(net, params, toks, 8, max_len=10)

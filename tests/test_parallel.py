@@ -337,15 +337,37 @@ def test_multihost_sharded_checkpoint_save_restore(tmp_path):
             np.testing.assert_array_equal(got, want)
         assert ro["momentum"]["w"].sharding == opt["momentum"]["w"].sharding
 
-        # unpad-at-save (net.unpad_params) on a multi-process mesh: an
-        # eager partition-dim slice of a non-fully-addressable padded
-        # param is a collective SPMD computation every process runs —
-        # it must work, not raise, so padded-storage checkpointing
-        # composes with multi-host training
-        padded = make((8, 6), P("data", "model"), 5)
-        sliced = padded[:, :5]
-        jax.block_until_ready(sliced)
-        assert sliced.shape == (8, 5)
+        # unpad-at-save on a multi-process mesh: the REAL
+        # net.unpad_params over a padded param that is not fully
+        # addressable from this process — the slice is a collective
+        # SPMD computation every process runs; it must work, not
+        # raise, so padded-storage checkpointing composes with
+        # multi-host training
+        from singa_tpu.config.schema import model_config_from_dict
+        from singa_tpu.core.net import build_net
+        netcfg = model_config_from_dict({
+            "name": "mh", "neuralnet": {"layer": [
+                {"name": "data", "type": "kShardData",
+                 "data_param": {"batchsize": 4}},
+                {"name": "img", "type": "kMnistImage",
+                 "srclayers": "data"},
+                {"name": "label", "type": "kLabel", "srclayers": "data"},
+                {"name": "ip", "type": "kInnerProduct",
+                 "srclayers": "img", "partition_type": "kLayerPartition",
+                 "inner_product_param": {"num_output": 5},
+                 "param": [{"name": "w"}, {"name": "b"}]},
+                {"name": "loss", "type": "kSoftmaxLoss",
+                 "srclayers": ["ip", "label"]},
+            ]}})
+        net = build_net(netcfg, "kTrain",
+                        {"data": {"pixel": (8,), "label": ()}})
+        wname = [n for n, s in net.param_specs.items()
+                 if s.shape == (8, 5)][0]
+        # stored padded 5 -> 6 (model=2), sharded across both processes
+        unpadded = net.unpad_params(
+            {wname: make((8, 6), P("data", "model"), 5)})
+        jax.block_until_ready(unpadded[wname])
+        assert unpadded[wname].shape == (8, 5)
         print(f"proc{pid} sharded_ckpt_ok step={step}", flush=True)
     """))
 
