@@ -836,8 +836,6 @@ class Trainer:
         shard_params).  After the restore, re-pad + re-shard under the
         trainer's mesh so the padded sharded layout survives a
         resume."""
-        import jax
-
         from ..utils.checkpoint import CheckpointManager
         net = self.train_net
         # abstract template: checkpoint-shaped (spec, unpadded) leaves

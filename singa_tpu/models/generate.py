@@ -140,7 +140,7 @@ def forward_cached(net: NeuralNet, params, tokens: jnp.ndarray,
 
 
 def _sample(logits: jnp.ndarray, key, temperature: float,
-            top_k: int) -> jnp.ndarray:
+            top_k: int, top_p: float) -> jnp.ndarray:
     """logits: (B, V) -> (B,) int32.  temperature 0 = greedy."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -148,12 +148,23 @@ def _sample(logits: jnp.ndarray, key, temperature: float,
     if top_k > 0 and top_k < logits.shape[-1]:
         kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
         logits = jnp.where(logits < kth, -1e30, logits)
+    if 0.0 < top_p < 1.0:
+        # nucleus: keep the smallest prefix of descending-prob tokens
+        # whose mass reaches top_p.  A token is kept iff the mass
+        # STRICTLY BEFORE it is < top_p (the top-1 token is always
+        # kept); static shapes — one sort + cumsum over V
+        desc = -jnp.sort(-logits, axis=-1)
+        probs = jax.nn.softmax(desc, axis=-1)
+        before = jnp.cumsum(probs, axis=-1) - probs
+        kth = jnp.min(jnp.where(before < top_p, desc, jnp.inf),
+                      axis=-1, keepdims=True)
+        logits = jnp.where(logits < kth, -1e30, logits)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnums=(0, 3, 5, 6, 7, 8))
+@partial(jax.jit, static_argnums=(0, 3, 5, 6, 7, 8, 9))
 def _generate_jit(net, params, prompt, max_new_tokens, key,
-                  temperature, top_k, eos_id, max_len):
+                  temperature, top_k, eos_id, max_len, top_p):
     b, p = prompt.shape
     if max_len is None:
         max_len = p + max_new_tokens
@@ -167,14 +178,15 @@ def _generate_jit(net, params, prompt, max_new_tokens, key,
 
     logits, cache = forward_cached(net, params, prompt, cache, 0)
     keys = jax.random.split(key, max_new_tokens)
-    tok0 = _sample(logits[:, -1], keys[0], temperature, top_k)
+    tok0 = _sample(logits[:, -1], keys[0], temperature, top_k,
+                   top_p)
     done0 = (jnp.zeros((b,), jnp.bool_) if eos_id is None
              else tok0 == eos_id)
 
     def step(carry, k):
         tok, cache, pos, done = carry
         logits, cache = forward_cached(net, params, tok[:, None], cache, pos)
-        nxt = _sample(logits[:, -1], k, temperature, top_k)
+        nxt = _sample(logits[:, -1], k, temperature, top_k, top_p)
         if eos_id is not None:
             nxt = jnp.where(done, eos_id, nxt)
             done = done | (nxt == eos_id)
@@ -189,15 +201,18 @@ def generate(net: NeuralNet, params, prompt,
              max_new_tokens: int, key: Optional[jax.Array] = None,
              temperature: float = 0.0, top_k: int = 0,
              eos_id: Optional[int] = None,
-             max_len: Optional[int] = None) -> jnp.ndarray:
+             max_len: Optional[int] = None,
+             top_p: float = 0.0) -> jnp.ndarray:
     """Sample `max_new_tokens` continuations of `prompt` ((B, P) int32).
     Returns the (B, max_new_tokens) generated tokens.  One compiled
-    program: prefill + a lax.scan decode loop with per-step sampling
-    (greedy when temperature == 0; top-k truncation when top_k > 0).
-    After `eos_id` is produced, a sequence keeps emitting `eos_id`.
-    `max_len` over-allocates the KV cache beyond prompt+new (the tail
-    is mask-ignored) — lets callers fix the cache geometry across runs
-    of different lengths (bench.py isolates prefill this way)."""
+    program: prefill + a lax.scan decode loop with per-token sampling
+    (greedy when temperature == 0; top-k truncation when top_k > 0;
+    nucleus truncation when 0 < top_p < 1 — both filters compose,
+    top-k first).  After `eos_id` is produced, a sequence keeps
+    emitting `eos_id`.  `max_len` over-allocates the KV cache beyond
+    prompt+new (the tail is mask-ignored) — lets callers fix the cache
+    geometry across runs of different lengths (bench.py isolates
+    prefill this way)."""
     if key is None:
         key = jax.random.PRNGKey(0)
     prompt = jnp.asarray(prompt, jnp.int32)
@@ -205,4 +220,5 @@ def generate(net: NeuralNet, params, prompt,
         return jnp.zeros((prompt.shape[0], 0), jnp.int32)
     return _generate_jit(net, params, prompt, int(max_new_tokens), key,
                          float(temperature), int(top_k), eos_id,
-                         None if max_len is None else int(max_len))
+                         None if max_len is None else int(max_len),
+                         float(top_p))

@@ -164,3 +164,30 @@ def test_generate_max_len_too_small_raises():
     toks = jnp.zeros((B, 6), jnp.int32)
     with pytest.raises(ValueError, match="max_len"):
         generate(net, params, toks, 8, max_len=10)
+
+
+def test_sample_top_p_truncates_to_nucleus():
+    """Nucleus sampling keeps the smallest descending-prob prefix whose
+    mass reaches top_p (top-1 always kept) and masks the rest."""
+    from singa_tpu.models.generate import _sample
+    logits = jnp.log(jnp.asarray([[0.6, 0.25, 0.1, 0.05]]))
+    # top_p=0.5: nucleus is {0} -> deterministic despite temperature 1
+    for i in range(5):
+        assert int(_sample(logits, jax.random.PRNGKey(i), 1.0, 0, 0.5)[0]) == 0
+    # top_p=0.7: before-mass [0, .6, .85, .95] -> nucleus {0, 1}
+    toks = {int(_sample(logits, jax.random.PRNGKey(i), 1.0, 0, 0.7)[0])
+            for i in range(40)}
+    assert toks == {0, 1}
+    # top_p=0 disables the filter: every token reachable
+    toks = {int(_sample(logits, jax.random.PRNGKey(i), 1.0, 0, 0.0)[0])
+            for i in range(120)}
+    assert toks == {0, 1, 2, 3}
+
+
+def test_generate_top_p_smoke():
+    net, params = _net_and_params(False)
+    toks = jnp.zeros((B, 4), jnp.int32)
+    out = generate(net, params, toks, 6, key=jax.random.PRNGKey(1),
+                   temperature=0.8, top_p=0.9)
+    assert out.shape == (B, 6)
+    assert (np.asarray(out) >= 0).all() and (np.asarray(out) < VOCAB).all()
