@@ -283,7 +283,6 @@ def bench_decode(batch_size=8, prompt_len=128, new_tokens=256,
             "value": round(tok_sec, 1),
             "unit": "tokens/sec/chip",
             "vs_baseline": None,
-
             "batch": batch_size, "prompt_len": prompt_len,
             "new_tokens": new_tokens,
             "ms_per_decode_step": round(decode_s * 1e3, 3),
