@@ -4,7 +4,7 @@
 # Successor of the reference's cluster ops glue (script/load_data.py's
 # placement step + script/node.sh's ssh fan-out): after
 #   python -m singa_tpu.tools.loader partition <shard_dir> <out_dir> \
-#       --nworker_groups G --nworkers_per_group W [--replicate]
+#       <nworkers> [group_size] [--replicate]
 # has produced <out_dir>/proc{i}/ folders, this pushes proc{i} to
 # <remote_dir>/proc{i}/ on the i-th host of a hostfile (same format
 # main.py consumes: one "host" or "host:port" per line, '#' comments

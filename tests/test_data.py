@@ -399,3 +399,29 @@ def test_partition_shard_group_semantics(tmp_path):
 
     with pytest.raises(ValueError):
         partition_shard(str(src), str(tmp_path / "bad"), 4, 3)
+
+
+def test_place_shards_dry_run_emits_rsync_plan(tmp_path):
+    """scripts/place_shards.sh (the load_data.py/node.sh ops-glue
+    successor) in its dry-run default: one rsync line per hostfile
+    process, ports stripped from the ssh target, proc{i} suffix kept
+    remotely, comments/blanks skipped, missing partitions warned."""
+    import os
+    import subprocess
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "place_shards.sh")
+    for i in range(2):
+        (tmp_path / f"proc{i}").mkdir()
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text(
+        "# comment\n\n10.0.0.1:5555\n10.0.0.2\n10.0.0.3\n")
+    r = subprocess.run(
+        ["bash", script, str(tmp_path), str(hostfile), "/data/shards"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    lines = r.stdout.strip().splitlines()
+    assert lines == [
+        f"rsync -az --mkpath {tmp_path}/proc0/ 10.0.0.1:/data/shards/proc0/",
+        f"rsync -az --mkpath {tmp_path}/proc1/ 10.0.0.2:/data/shards/proc1/",
+    ]
+    assert "proc2 missing" in r.stderr         # 3rd host, no partition
