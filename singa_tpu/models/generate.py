@@ -197,6 +197,112 @@ def _generate_jit(net, params, prompt, max_new_tokens, key,
     return jnp.concatenate([tok0[:, None], rest.T], axis=1)
 
 
+@partial(jax.jit, static_argnums=(0, 3, 4, 5, 6, 7))
+def _beam_jit(net, params, prompt, max_new_tokens, num_beams,
+              length_penalty, eos_id, max_len):
+    b, p = prompt.shape
+    w = num_beams
+    if max_len is None:
+        max_len = p + max_new_tokens
+    elif max_len < p + max_new_tokens:
+        raise ValueError(f"max_len={max_len} < prompt({p}) + "
+                         f"max_new_tokens({max_new_tokens})")
+    dtype = jax.tree_util.tree_leaves(params)[0].dtype
+    cache = init_cache(net, b, max_len, dtype)
+
+    logits, cache = forward_cached(net, params, prompt, cache, 0)
+    lp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
+    vocab = lp0.shape[-1]
+    # only min(W, V) distinct beams exist after one token; pad the rest
+    # with -inf scores so they never outrank a real candidate
+    k0 = min(w, vocab)
+    scores, tok = jax.lax.top_k(lp0, k0)              # (B, k0) each
+    if k0 < w:
+        scores = jnp.concatenate(
+            [scores, jnp.full((b, w - k0), -1e30, scores.dtype)], axis=1)
+        tok = jnp.concatenate(
+            [tok, jnp.tile(tok[:, :1], (1, w - k0))], axis=1)
+    tok = tok.astype(jnp.int32)
+    # beam-expand the cache: beam index varies fastest, so flat row
+    # b*W + j is batch b's beam j — matching the take() reorder below
+    cache = jax.tree_util.tree_map(
+        lambda a: jnp.repeat(a, w, axis=0), cache)
+    seqs = jnp.zeros((b, w, max_new_tokens), jnp.int32)
+    seqs = jnp.where(jnp.arange(max_new_tokens) == 0, tok[:, :, None],
+                     seqs)
+    done = (tok == eos_id) if eos_id is not None \
+        else jnp.zeros((b, w), jnp.bool_)
+    lengths = jnp.ones((b, w), jnp.int32)
+
+    def step(carry, t):
+        seqs, scores, cache, done, lengths, last = carry
+        logits, cache = forward_cached(
+            net, params, last.reshape(b * w, 1), cache, p + t - 1)
+        lp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32),
+                                axis=-1).reshape(b, w, vocab)
+        if eos_id is not None:
+            # a finished beam only continues with eos at zero cost, so
+            # its score freezes and it cannot spawn siblings
+            frozen = jnp.full((vocab,), -1e30,
+                              jnp.float32).at[eos_id].set(0.0)
+            lp = jnp.where(done[:, :, None], frozen, lp)
+        cand = (scores[:, :, None] + lp).reshape(b, w * vocab)
+        scores, idx = jax.lax.top_k(cand, w)
+        beam = idx // vocab
+        tokv = (idx % vocab).astype(jnp.int32)
+        seqs = jnp.take_along_axis(seqs, beam[:, :, None], axis=1)
+        seqs = jnp.where(jnp.arange(max_new_tokens) == t,
+                         tokv[:, :, None], seqs)
+        done = jnp.take_along_axis(done, beam, axis=1)
+        lengths = jnp.take_along_axis(lengths, beam, axis=1)
+        lengths = jnp.where(done, lengths, t + 1)
+        if eos_id is not None:
+            done = done | (tokv == eos_id)
+        flat = (jnp.arange(b)[:, None] * w + beam).reshape(-1)
+        cache = jax.tree_util.tree_map(
+            lambda a: jnp.take(a, flat, axis=0), cache)
+        return (seqs, scores, cache, done, lengths, tokv), None
+
+    if max_new_tokens > 1:
+        (seqs, scores, cache, done, lengths, _), _ = jax.lax.scan(
+            step, (seqs, scores, cache, done, lengths, tok),
+            jnp.arange(1, max_new_tokens))
+    if length_penalty:
+        ranked = scores / (lengths.astype(jnp.float32) ** length_penalty)
+    else:
+        ranked = scores
+    best = jnp.argmax(ranked, axis=1)
+    return (jnp.take_along_axis(seqs, best[:, None, None],
+                                axis=1)[:, 0],
+            jnp.take_along_axis(scores, best[:, None], axis=1)[:, 0])
+
+
+def beam_search(net: NeuralNet, params, prompt, max_new_tokens: int,
+                num_beams: int = 4, length_penalty: float = 0.0,
+                eos_id: Optional[int] = None,
+                max_len: Optional[int] = None
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Beam-search decode: returns (tokens (B, max_new_tokens) int32,
+    log-prob scores (B,) float32) for the best beam per sequence.  One
+    compiled program: prefill at batch B, then a lax.scan decode loop
+    at batch B·num_beams with per-step beam reordering of the KV cache
+    (static shapes throughout — the top-k over W·V candidates and the
+    cache `take` are ordinary XLA ops).  After `eos_id` a beam is
+    frozen: it keeps emitting eos at zero added cost and its score
+    stops moving.  `length_penalty` alpha divides final scores by
+    length**alpha for ranking (0 = rank by raw log-prob).  `max_len`
+    over-allocates the KV cache exactly as in generate() — pin it to
+    keep one compiled cache geometry across runs of different
+    lengths."""
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if int(max_new_tokens) <= 0:
+        b = prompt.shape[0]
+        return (jnp.zeros((b, 0), jnp.int32), jnp.zeros((b,), jnp.float32))
+    return _beam_jit(net, params, prompt, int(max_new_tokens),
+                     int(num_beams), float(length_penalty), eos_id,
+                     None if max_len is None else int(max_len))
+
+
 def generate(net: NeuralNet, params, prompt,
              max_new_tokens: int, key: Optional[jax.Array] = None,
              temperature: float = 0.0, top_k: int = 0,

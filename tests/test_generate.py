@@ -191,3 +191,95 @@ def test_generate_top_p_smoke():
                    temperature=0.8, top_p=0.9)
     assert out.shape == (B, 6)
     assert (np.asarray(out) >= 0).all() and (np.asarray(out) < VOCAB).all()
+
+
+def test_beam_search_finds_global_optimum_small_vocab():
+    """Oracle: with num_beams >= V**(T-1) beam search is exhaustive, so
+    its winner must equal the argmax-log-prob continuation over ALL
+    V**T candidates (scored by teacher-forced forward)."""
+    import itertools
+
+    from singa_tpu.models.generate import beam_search
+    cfg = transformer_lm(vocab_size=4, num_layers=2, embed_dim=32,
+                         num_heads=4, head_dim=8, seq_len=SEQ, batchsize=1)
+    net = build_net(cfg, "kTest", SHAPES)
+    params = net.init_params(jax.random.PRNGKey(3))
+    prompt = jnp.asarray([[1, 2]], jnp.int32)
+    T = 3
+    toks, score = beam_search(net, params, prompt, T, num_beams=16)
+
+    best_seq, best_lp = None, -np.inf
+    for cand in itertools.product(range(4), repeat=T):
+        full = jnp.concatenate(
+            [prompt, jnp.asarray([cand], jnp.int32)], axis=1)
+        cache = init_cache(net, 1, full.shape[1])
+        logits, _ = forward_cached(net, params, full, cache, 0)
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        total = sum(float(lp[0, prompt.shape[1] - 1 + i, cand[i]])
+                    for i in range(T))
+        if total > best_lp:
+            best_lp, best_seq = total, cand
+    assert tuple(np.asarray(toks)[0]) == best_seq
+    assert float(score[0]) == pytest.approx(best_lp, abs=1e-3)
+
+
+def test_beam_search_width_one_is_greedy():
+    from singa_tpu.models.generate import beam_search
+    net, params = _net_and_params(False)
+    prompt = jnp.asarray(
+        np.random.default_rng(7).integers(0, VOCAB, (B, 5)), jnp.int32)
+    greedy = generate(net, params, prompt, 6)
+    beams, _ = beam_search(net, params, prompt, 6, num_beams=1)
+    np.testing.assert_array_equal(np.asarray(beams), np.asarray(greedy))
+
+
+def test_beam_search_eos_freezes_beam():
+    from singa_tpu.models.generate import beam_search
+    net, params = _net_and_params(False)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    eos = int(np.asarray(generate(net, params, prompt, 1))[0, 0])
+    toks, _ = beam_search(net, params, prompt, 6, num_beams=2,
+                          eos_id=eos)
+    row = np.asarray(toks)[0]
+    # once eos appears every later slot is eos (the frozen-beam contract)
+    hit = np.argmax(row == eos)
+    assert row[hit] == eos and (row[hit:] == eos).all()
+
+
+def test_beam_search_length_penalty_matches_bruteforce():
+    """alpha=1.0 ranking (score/length) against brute force over all
+    V**T continuations, with eos-frozen lengths: the winner under the
+    penalized objective must match."""
+    import itertools
+
+    from singa_tpu.models.generate import beam_search
+    cfg = transformer_lm(vocab_size=4, num_layers=2, embed_dim=32,
+                         num_heads=4, head_dim=8, seq_len=SEQ, batchsize=1)
+    net = build_net(cfg, "kTest", SHAPES)
+    params = net.init_params(jax.random.PRNGKey(9))
+    prompt = jnp.asarray([[3, 0]], jnp.int32)
+    T, EOS = 3, 1
+    toks, _ = beam_search(net, params, prompt, T, num_beams=16,
+                          length_penalty=1.0, eos_id=EOS,
+                          max_len=prompt.shape[1] + T + 2)  # over-alloc ok
+    best_seq, best = None, -np.inf
+    for cand in itertools.product(range(4), repeat=T):
+        # canonical frozen form: after eos, only eos continuations exist
+        if EOS in cand:
+            cut = cand.index(EOS)
+            if any(c != EOS for c in cand[cut:]):
+                continue
+            length = cut + 1
+        else:
+            length = T
+        full = jnp.concatenate(
+            [prompt, jnp.asarray([cand], jnp.int32)], axis=1)
+        cache = init_cache(net, 1, full.shape[1])
+        logits, _ = forward_cached(net, params, full, cache, 0)
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        total = sum(float(lp[0, prompt.shape[1] - 1 + i, cand[i]])
+                    for i in range(length))   # frozen tail adds zero
+        score = total / length
+        if score > best:
+            best, best_seq = score, cand
+    assert tuple(np.asarray(toks)[0]) == best_seq
