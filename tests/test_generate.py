@@ -311,3 +311,10 @@ def test_decode_with_tp_sharded_params_matches_unsharded():
     np.testing.assert_array_equal(np.asarray(tb), np.asarray(bb))
     np.testing.assert_allclose(np.asarray(ts), np.asarray(bs),
                                rtol=1e-4, atol=1e-4)
+
+    # and DP: prompts sharded over the data axis compose with the
+    # TP-sharded params in the same programs
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    dprompt = jax.device_put(prompt, NamedSharding(mesh, P("data")))
+    np.testing.assert_array_equal(
+        np.asarray(generate(net, sp, dprompt, 6)), base)
