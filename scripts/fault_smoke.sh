@@ -1,0 +1,117 @@
+#!/usr/bin/env bash
+# Fault-tolerance smoke (ISSUE 1 satellite): a 20-step synthetic-data
+# training run under a seeded FaultSchedule — one mid-run preemption
+# plus one corrupt record — must be recovered by the Supervisor to the
+# SAME final loss as an uninterrupted run (float tolerance; the config
+# is dropout-free so the trajectories are bit-identical in practice).
+#
+# Usage: scripts/fault_smoke.sh        (CPU-only, no data, ~30s)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+python - <<'EOF'
+import os
+import tempfile
+
+import numpy as np
+
+from singa_tpu.config.schema import model_config_from_dict
+from singa_tpu.core.supervisor import Supervisor
+from singa_tpu.core.trainer import Trainer
+from singa_tpu.data.pipeline import prefetch
+from singa_tpu.data.synthetic import synthetic_image_batches
+from singa_tpu.utils.faults import Backoff, FaultSchedule, inject
+
+STEPS = 20
+SHAPES = {"data": {"pixel": (28, 28), "label": ()}}
+
+
+def cfg():
+    return model_config_from_dict({
+        "name": "fault-smoke", "train_steps": STEPS,
+        "checkpoint_frequency": 5,
+        "updater": {"type": "kSGD", "base_learning_rate": 0.01,
+                    "learning_rate_change_method": "kFixed"},
+        "neuralnet": {"layer": [
+            {"name": "data", "type": "kShardData",
+             "data_param": {"batchsize": 8}},
+            {"name": "mnist", "type": "kMnistImage", "srclayers": "data",
+             "mnist_param": {"norm_a": 255.0}},
+            {"name": "label", "type": "kLabel", "srclayers": "data"},
+            {"name": "ip1", "type": "kInnerProduct", "srclayers": "mnist",
+             "inner_product_param": {"num_output": 32},
+             "param": [{"name": "w1",
+                        "init_method": "kUniformSqrtFanIn"},
+                       {"name": "b1"}]},
+            {"name": "ip2", "type": "kInnerProduct", "srclayers": "ip1",
+             "inner_product_param": {"num_output": 10},
+             "param": [{"name": "w2",
+                        "init_method": "kUniformSqrtFanIn"},
+                       {"name": "b2"}]},
+            {"name": "loss", "type": "kSoftmaxLoss",
+             "srclayers": ["ip2", "label"]}]}})
+
+
+def data_factory():
+    # prefetch-wrapped so the data.decode fault site (and quarantine)
+    # is on the path, exactly as resolve_data_source wires it
+    return prefetch(synthetic_image_batches(8, seed=7, stream_seed=111))
+
+
+def run_baseline():
+    losses = []
+    tr = Trainer(cfg(), SHAPES, log_fn=lambda s: None, donate=False)
+    p, o = tr.init(seed=0)
+    tr.run(p, o, data_factory(), seed=0,
+           hooks=[lambda s, m: losses.append(float(m["loss"]))])
+    return losses
+
+
+def run_supervised(workspace):
+    losses = {}
+    tr = Trainer(cfg(), SHAPES, log_fn=print, donate=False)
+    sup = Supervisor(tr, workspace, max_restarts=3,
+                     backoff=Backoff(base=0.05, cap=0.2, seed=0),
+                     log=print)
+    # one corrupt record early (quarantined, stream continues in
+    # order) + one preemption at step 12 (restore step-10 snapshot,
+    # replay steps 10..19)
+    sched = FaultSchedule.parse(
+        "data.decode@4:corrupt,step.train@12:preempt", seed=0)
+    with inject(sched):
+        sup.run(data_factory, seed=0,
+                hooks=[lambda s, m: losses.__setitem__(
+                    s, float(m["loss"]))])
+    assert [f.kind for f in sup.failures] == ["preemption"], sup.failures
+    assert {f.site for f in sched.fired} == \
+        {"data.decode", "step.train"}, sched.fired
+    return [losses[s] for s in range(STEPS)]
+
+
+base = run_baseline()
+with tempfile.TemporaryDirectory(prefix="fault_smoke_") as ws:
+    sup = run_supervised(ws)
+
+final_base, final_sup = base[-1], sup[-1]
+print(f"final loss: uninterrupted {final_base:.6f}  "
+      f"supervised {final_sup:.6f}")
+assert np.isfinite(final_sup)
+assert abs(final_base - final_sup) <= 1e-5 * max(1.0, abs(final_base)), \
+    (final_base, final_sup)
+# the whole per-step trajectory matches, not just the endpoint
+np.testing.assert_allclose(sup, base, rtol=1e-5, atol=1e-6)
+print("FAULT SMOKE PASS: recovered run matches the uninterrupted one")
+EOF
+
+# CLI leg: the same machinery through singa_tpu.main's --max-restarts /
+# --fault_spec flags (synthetic data, supervised, one preemption)
+WS=$(mktemp -d -t fault_smoke_cli_XXXX)
+trap 'rm -rf "$WS"' EXIT
+python -m singa_tpu.main -model_conf examples/mnist/mlp.conf \
+    --synthetic --steps 20 --workspace "$WS" \
+    --max-restarts 3 --fault_spec "step.train@8:preempt" \
+    | grep -E "fault injection active|supervisor|training done" || {
+        echo "FAULT SMOKE CLI LEG FAILED"; exit 1; }
+echo "FAULT SMOKE CLI PASS"

@@ -5,3 +5,4 @@ from .layers import (Layer, LayerError, ParamSpec, Context, create_layer,
                      register_layer, LAYER_REGISTRY)
 from .net import NeuralNet, build_net
 from .trainer import Trainer, Performance, TimerInfo
+from .supervisor import Supervisor, TrainingAborted, FailureRecord

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from ..config.schema import ModelConfig
+from ..utils import faults
 from .net import NeuralNet, build_net
 from .updater import Updater, make_updater
 
@@ -129,7 +130,8 @@ class Trainer:
         self.multipliers = self.train_net.multipliers()
         self._pipeline_nets = self._maybe_pipeline(n_micro)
         from ..parallel.elastic import ElasticController, async_active
-        self.elastic = (ElasticController(model_cfg.updater, ngroups)
+        self.elastic = (ElasticController(model_cfg.updater, ngroups,
+                                          log_fn=log_fn)
                         if async_active(model_cfg.updater) else None)
         self._build_steps(donate)
         self.perf = Performance()
@@ -569,97 +571,103 @@ class Trainer:
                      f"{self.cfg.updater.warmup_steps}")
         history: List[Dict[str, float]] = []
         step = start_step
-        while step < self.cfg.train_steps:
-            if interrupted:
-                self.log(f"signal {interrupted[0]} received: checkpointing "
-                         f"at step {step} and stopping")
-                ckpt.save(step, *self._ckpt_state(params, opt_state))
-                break
-            if self.val_step and self.validate_now(step) and val_iter_factory:
-                avg = self.evaluate(params, val_iter_factory(),
-                                    self.cfg.validation_steps, self.val_step)
-                self.log(f"step-{step} validation: " + ", ".join(
-                    f"{k} : {v:.6f}" for k, v in sorted(avg.items())))
-            if self.test_step and self.test_now(step) and test_iter_factory:
-                avg = self.evaluate(params, test_iter_factory(),
-                                    self.cfg.test_steps, self.test_step)
-                self.log(f"step-{step} test: " + ", ".join(
-                    f"{k} : {v:.6f}" for k, v in sorted(avg.items())))
-                history.append({"step": step, **avg})
+        try:
+            while step < self.cfg.train_steps:
+                faults.maybe_fault("step.train")
+                if interrupted:
+                    self.log(f"signal {interrupted[0]} received: checkpointing "
+                             f"at step {step} and stopping")
+                    ckpt.save(step, *self._ckpt_state(params, opt_state))
+                    break
+                if self.val_step and self.validate_now(step) and val_iter_factory:
+                    avg = self.evaluate(params, val_iter_factory(),
+                                        self.cfg.validation_steps, self.val_step)
+                    self.log(f"step-{step} validation: " + ", ".join(
+                        f"{k} : {v:.6f}" for k, v in sorted(avg.items())))
+                if self.test_step and self.test_now(step) and test_iter_factory:
+                    avg = self.evaluate(params, test_iter_factory(),
+                                        self.cfg.test_steps, self.test_step)
+                    self.log(f"step-{step} test: " + ", ".join(
+                        f"{k} : {v:.6f}" for k, v in sorted(avg.items())))
+                    history.append({"step": step, **avg})
 
-            n = (self._next_chunk_len(step, scan_chunk)
-                 if scan_chunk and scan_chunk > 1 else 1)
-            t0 = time.perf_counter()
-            if n == 1:
-                batch = next(train_iter)
-                t1 = time.perf_counter()
-                params, opt_state, metrics = self.train_step(
-                    params, opt_state, batch, step,
-                    jax.random.fold_in(rng, step))
-                per_step = [jax.device_get(metrics)]
-            else:
-                batches = [next(train_iter) for _ in range(n)]
-                stacked = jax.tree_util.tree_map(
-                    lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
-                    *batches)
-                t1 = time.perf_counter()
-                params, opt_state, metrics = self.train_steps(
-                    params, opt_state, stacked, step, rng, n, True)
-                md = jax.device_get(metrics)
-                per_step = [{k: v[i] for k, v in md.items()}
-                            for i in range(n)]
-            t2 = time.perf_counter()
-            self.timer.add("data", t1 - t0)
-            self.timer.add("train", t2 - t1)
-            self.timer.steps += n
-            for i, m in enumerate(per_step):
-                s = step + i
-                self.perf.update(m)
-                if hooks:
-                    for h in hooks:
-                        h(s, m)
-                if self.display_now(s):
-                    if (self.timer.phase_shares is None
-                            and (getattr(self, "phase_profile", False)
-                                 or os.environ.get(
-                                     "SINGA_TPU_PHASE_PROFILE") == "1")):
-                        # one-shot device fwd/bwd/update attribution;
-                        # never let a profiler hiccup kill training
-                        try:
-                            self.profile_phases(
-                                params, opt_state,
-                                batch if n == 1 else batches[-1],
-                                step=step, rng=rng)
-                        except Exception as e:  # pragma: no cover
-                            self.timer.phase_shares = {}
-                            self.log(f"warning: phase profile failed: "
-                                     f"{e}")
-                    self.log(f"step-{s}: {self.perf.to_string()}")
-                    self.log(self.timer.to_string())
-                    self.perf.reset()
-            if (self.debug_step is not None
-                    and any(self.display_now(step + i) for i in range(n))):
-                # debug norms reflect the post-chunk params, so label
-                # them with the chunk's last step, not a mid-chunk one
-                s_dbg = step + n - 1
-                dbg_batch = batch if n == 1 else batches[-1]
-                outs, grads = self.debug_step(
-                    params, dbg_batch, s_dbg,
-                    jax.random.fold_in(rng, s_dbg))
-                self.log(f"step-{s_dbg} debug:\n" +
-                         self.train_net.debug_info(params, outs, grads))
-            if self.elastic is not None:
-                # chunks are cut so at most the LAST step is a sync step
-                params = self.elastic.maybe_sync(
-                    step + n - 1, params,
-                    rng=jax.random.fold_in(rng, step + n - 1))
-            last = step + n - 1
-            if (ckpt is not None and self.cfg.checkpoint_frequency > 0
-                    and last >= self.cfg.checkpoint_after_steps
-                    and (last + 1) % self.cfg.checkpoint_frequency == 0):
-                ckpt.save(last + 1, *self._ckpt_state(params, opt_state))
-            step += n
-        self._ckpt_unguard(old_handlers)
+                n = (self._next_chunk_len(step, scan_chunk)
+                     if scan_chunk and scan_chunk > 1 else 1)
+                t0 = time.perf_counter()
+                if n == 1:
+                    batch = next(train_iter)
+                    t1 = time.perf_counter()
+                    params, opt_state, metrics = self.train_step(
+                        params, opt_state, batch, step,
+                        jax.random.fold_in(rng, step))
+                    per_step = [jax.device_get(metrics)]
+                else:
+                    batches = [next(train_iter) for _ in range(n)]
+                    stacked = jax.tree_util.tree_map(
+                        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+                        *batches)
+                    t1 = time.perf_counter()
+                    params, opt_state, metrics = self.train_steps(
+                        params, opt_state, stacked, step, rng, n, True)
+                    md = jax.device_get(metrics)
+                    per_step = [{k: v[i] for k, v in md.items()}
+                                for i in range(n)]
+                t2 = time.perf_counter()
+                self.timer.add("data", t1 - t0)
+                self.timer.add("train", t2 - t1)
+                self.timer.steps += n
+                for i, m in enumerate(per_step):
+                    s = step + i
+                    self.perf.update(m)
+                    if hooks:
+                        for h in hooks:
+                            h(s, m)
+                    if self.display_now(s):
+                        if (self.timer.phase_shares is None
+                                and (getattr(self, "phase_profile", False)
+                                     or os.environ.get(
+                                         "SINGA_TPU_PHASE_PROFILE") == "1")):
+                            # one-shot device fwd/bwd/update attribution;
+                            # never let a profiler hiccup kill training
+                            try:
+                                self.profile_phases(
+                                    params, opt_state,
+                                    batch if n == 1 else batches[-1],
+                                    step=step, rng=rng)
+                            except Exception as e:  # pragma: no cover
+                                self.timer.phase_shares = {}
+                                self.log(f"warning: phase profile failed: "
+                                         f"{e}")
+                        self.log(f"step-{s}: {self.perf.to_string()}")
+                        self.log(self.timer.to_string())
+                        self.perf.reset()
+                if (self.debug_step is not None
+                        and any(self.display_now(step + i) for i in range(n))):
+                    # debug norms reflect the post-chunk params, so label
+                    # them with the chunk's last step, not a mid-chunk one
+                    s_dbg = step + n - 1
+                    dbg_batch = batch if n == 1 else batches[-1]
+                    outs, grads = self.debug_step(
+                        params, dbg_batch, s_dbg,
+                        jax.random.fold_in(rng, s_dbg))
+                    self.log(f"step-{s_dbg} debug:\n" +
+                             self.train_net.debug_info(params, outs, grads))
+                if self.elastic is not None:
+                    # chunks are cut so at most the LAST step is a sync step
+                    params = self.elastic.maybe_sync(
+                        step + n - 1, params,
+                        rng=jax.random.fold_in(rng, step + n - 1))
+                last = step + n - 1
+                if (ckpt is not None and self.cfg.checkpoint_frequency > 0
+                        and last >= self.cfg.checkpoint_after_steps
+                        and (last + 1) % self.cfg.checkpoint_frequency == 0):
+                    ckpt.save(last + 1, *self._ckpt_state(params, opt_state))
+                step += n
+        finally:
+            # an exception mid-loop (injected fault, data
+            # failure) must not leave our signal handlers
+            # installed in the supervisor's process
+            self._ckpt_unguard(old_handlers)
         if (ckpt is not None and not interrupted
                 and self.cfg.train_steps > start_step):
             ckpt.save(self.cfg.train_steps, *self._ckpt_state(params, opt_state))
@@ -681,7 +689,7 @@ class Trainer:
         ckpt = None
         if workspace and self.cfg.checkpoint_frequency > 0:
             from ..utils.checkpoint import CheckpointManager
-            ckpt = CheckpointManager(workspace)
+            ckpt = CheckpointManager(workspace, log_fn=self.log)
         interrupted: List[int] = []
         old_handlers: Dict[Any, Any] = {}
         if ckpt is not None:
@@ -779,48 +787,51 @@ class Trainer:
         chains: Dict[int, Any] = {}   # PCD chain per RBM index
         ckpt, interrupted, old_handlers = self._ckpt_guard(workspace)
         step = start_step
-        for step in range(start_step, total):
-            if interrupted:
-                self.log(f"signal {interrupted[0]} received: "
-                         f"checkpointing at step {step} and stopping")
-                ckpt.save(step, *self._ckpt_state(params, opt_state))
-                break
-            if (self.test_step and self.test_now(step)
-                    and test_iter_factory):
-                avg = self.evaluate(params, test_iter_factory(),
-                                    self.cfg.test_steps, self.test_step)
-                self.log(f"step-{step} test: " + ", ".join(
-                    f"{k} : {v:.6f}" for k, v in sorted(avg.items())))
-            if (self.val_step and self.validate_now(step)
-                    and val_iter_factory):
-                avg = self.evaluate(params, val_iter_factory(),
-                                    self.cfg.validation_steps,
-                                    self.val_step)
-                self.log(f"step-{step} validation: " + ", ".join(
-                    f"{k} : {v:.6f}" for k, v in sorted(avg.items())))
-            idx = min(step * n // max(total, 1), n - 1)
-            layer = net.layers[rbm_names[idx]]
-            batch = next(train_iter)
-            params, opt_state, recon, chain_end = cd_step(
-                params, opt_state, batch, jax.random.fold_in(rng, step),
-                idx, step, chains.get(idx) if layer.persistent else None)
-            if layer.persistent:
-                chains[idx] = chain_end
-            self.perf.update({"recon": recon})
-            if hooks:
-                for h in hooks:
-                    h(step, {"recon": float(recon), "rbm": idx})
-            if self.display_now(step):
-                self.log(f"step-{step} cd[{rbm_names[idx]}]: "
-                         f"{self.perf.to_string()}")
-                history.append({"step": step, "rbm": idx,
-                                **self.perf.averages()})
-                self.perf.reset()
-            if (ckpt is not None and self.cfg.checkpoint_frequency > 0
-                    and step >= self.cfg.checkpoint_after_steps
-                    and (step + 1) % self.cfg.checkpoint_frequency == 0):
-                ckpt.save(step + 1, *self._ckpt_state(params, opt_state))
-        self._ckpt_unguard(old_handlers)
+        try:
+            for step in range(start_step, total):
+                faults.maybe_fault("step.train")
+                if interrupted:
+                    self.log(f"signal {interrupted[0]} received: "
+                             f"checkpointing at step {step} and stopping")
+                    ckpt.save(step, *self._ckpt_state(params, opt_state))
+                    break
+                if (self.test_step and self.test_now(step)
+                        and test_iter_factory):
+                    avg = self.evaluate(params, test_iter_factory(),
+                                        self.cfg.test_steps, self.test_step)
+                    self.log(f"step-{step} test: " + ", ".join(
+                        f"{k} : {v:.6f}" for k, v in sorted(avg.items())))
+                if (self.val_step and self.validate_now(step)
+                        and val_iter_factory):
+                    avg = self.evaluate(params, val_iter_factory(),
+                                        self.cfg.validation_steps,
+                                        self.val_step)
+                    self.log(f"step-{step} validation: " + ", ".join(
+                        f"{k} : {v:.6f}" for k, v in sorted(avg.items())))
+                idx = min(step * n // max(total, 1), n - 1)
+                layer = net.layers[rbm_names[idx]]
+                batch = next(train_iter)
+                params, opt_state, recon, chain_end = cd_step(
+                    params, opt_state, batch, jax.random.fold_in(rng, step),
+                    idx, step, chains.get(idx) if layer.persistent else None)
+                if layer.persistent:
+                    chains[idx] = chain_end
+                self.perf.update({"recon": recon})
+                if hooks:
+                    for h in hooks:
+                        h(step, {"recon": float(recon), "rbm": idx})
+                if self.display_now(step):
+                    self.log(f"step-{step} cd[{rbm_names[idx]}]: "
+                             f"{self.perf.to_string()}")
+                    history.append({"step": step, "rbm": idx,
+                                    **self.perf.averages()})
+                    self.perf.reset()
+                if (ckpt is not None and self.cfg.checkpoint_frequency > 0
+                        and step >= self.cfg.checkpoint_after_steps
+                        and (step + 1) % self.cfg.checkpoint_frequency == 0):
+                    ckpt.save(step + 1, *self._ckpt_state(params, opt_state))
+        finally:
+            self._ckpt_unguard(old_handlers)
         if ckpt is not None and not interrupted and total > start_step:
             ckpt.save(total, *self._ckpt_state(params, opt_state))
         return params, opt_state, history
@@ -868,7 +879,7 @@ class Trainer:
         tpl_p = shard_tpl(tpl_p, params)
         tpl_o = {k: shard_tpl(t, opt_state.get(k, {}))
                  for k, t in tpl_o.items()}
-        restored = CheckpointManager(workspace).restore(
+        restored = CheckpointManager(workspace, log_fn=self.log).restore(
             template={"params": tpl_p, "opt_state": tpl_o})
         if restored is None:
             return params, opt_state, 0

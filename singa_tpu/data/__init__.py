@@ -7,7 +7,8 @@ from typing import Callable, Iterator, Tuple
 from .discovery import discover_input_shapes
 from .records import Datum, Record, SingleLabelImageRecord
 from .shard import Shard, ShardError
-from .pipeline import Prefetcher, prefetch, shard_batches
+from .pipeline import (PipelineStats, PrefetchError, Prefetcher, prefetch,
+                       shard_batches)
 from .synthetic import synthetic_image_batches
 
 
@@ -35,6 +36,10 @@ def resolve_data_source(model_cfg, batchsize: int, seed: int = 0,
         from .discovery import discover_input_shapes as _discover
         sample_shapes = _discover(model_cfg,
                                   force_synthetic=force_synthetic)
+    # one stats object per resolved source: train iterator and every
+    # test-factory iterator share the quarantine tally, and the
+    # returned Prefetcher exposes it as `.stats`
+    stats = PipelineStats()
     train_path = test_path = None
     train_name = test_name = "data"
     layers = model_cfg.neuralnet.layer if model_cfg.neuralnet else []
@@ -49,8 +54,9 @@ def resolve_data_source(model_cfg, batchsize: int, seed: int = 0,
             mk = lambda s: synthetic_token_batches(  # noqa: E731
                 batchsize, p.seq_len, p.vocab_size, seed=s,
                 data_layer=layer.name, table_seed=1234 + seed)
-            return (mk(stream_seed if stream_seed is not None
-                       else seed), (lambda: mk(seed + 7919)))
+            return (prefetch(mk(stream_seed if stream_seed is not None
+                                else seed), stats=stats),
+                    (lambda: mk(seed + 7919)))
 
     # the SAME existence predicates discovery uses to size the net —
     # the two must never diverge or served batches mismatch the net
@@ -100,29 +106,30 @@ def resolve_data_source(model_cfg, batchsize: int, seed: int = 0,
         train_iter = prefetch(lmdb_batches(
             train_path, batchsize, train_name,
             seed=(stream_seed if stream_seed is not None else seed),
-            random_skip=train_skip))
+            random_skip=train_skip, stats=stats), stats=stats)
     elif shard_ok(train_path):
         _warn_identical_streams("shard")
         train_iter = prefetch(
             shard_batches(train_path, batchsize, train_name,
                           seed=(stream_seed if stream_seed is not None
                                 else seed),
-                          random_skip=train_skip))
+                          random_skip=train_skip, stats=stats),
+            stats=stats)
     else:
         # train/test must share the class templates (`seed`) and differ
         # only in the sample stream — templates keyed by different
         # seeds are unrelated tasks and make test accuracy pure noise
-        train_iter = synthetic_image_batches(
+        train_iter = prefetch(synthetic_image_batches(
             batchsize, data_layer=train_name, seed=seed,
             image_shape=_pixel_shape(sample_shapes, train_name),
             stream_seed=(stream_seed if stream_seed is not None
-                         else seed + 101))
+                         else seed + 101)), stats=stats)
     if test_lmdb and lmdb_ok(test_path):
         test_factory = lambda: lmdb_batches(
-            test_path, batchsize, test_name, loop=False)
+            test_path, batchsize, test_name, loop=False, stats=stats)
     elif shard_ok(test_path):
         test_factory = lambda: shard_batches(
-            test_path, batchsize, test_name, loop=False)
+            test_path, batchsize, test_name, loop=False, stats=stats)
     else:
         test_factory = lambda: synthetic_image_batches(
             batchsize, data_layer=test_name, seed=seed,

@@ -15,16 +15,29 @@ from typing import Iterator, Optional, Tuple
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "..", "..",
                          "native", "libsinga_native.so")
 _lib = None
+_lib_failed = False
 
 
 def load_library() -> Optional[ctypes.CDLL]:
-    global _lib
+    global _lib, _lib_failed
     if _lib is not None:
         return _lib
+    if _lib_failed:
+        return None
     path = os.path.abspath(_LIB_PATH)
     if not os.path.exists(path):
         return None
-    lib = ctypes.CDLL(path)
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError as e:
+        # a built .so that cannot load (ABI/runtime mismatch, e.g. an
+        # older libstdc++ than the build host's) must degrade to the
+        # pure-Python codec, not crash every batch decode
+        _lib_failed = True
+        import sys
+        print(f"warning: native shard library unusable ({e}); "
+              f"falling back to the Python codec", file=sys.stderr)
+        return None
     u8p = ctypes.POINTER(ctypes.c_uint8)
     lib.shard_open_read.restype = ctypes.c_void_p
     lib.shard_open_read.argtypes = [ctypes.c_char_p]

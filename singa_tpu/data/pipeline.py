@@ -5,18 +5,61 @@ thread and a double-buffered ParserLayer handoff (worker.cc:127-177,
 base_layer.h:510-560).  Here a background thread keeps a bounded queue
 of ready batches ahead of the device; normalization happens *on device*
 inside the jitted step, so host work is pure file I/O + batching.
+
+Failure semantics (the hardening tier — see docs/FAULT_TOLERANCE.md):
+a producer-thread exception is re-raised on the consumer side; a
+producer that dies without signaling raises PrefetchError instead of
+hanging the trainer (liveness is polled, never assumed); corrupt
+records are quarantined — skipped and counted per pass in a shared
+PipelineStats — rather than silently dropped or fatally raised.  The
+`data.decode` / `data.prefetch` fault-injection sites (utils.faults)
+make all three paths testable.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
+from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterator, List, Optional
 
 import numpy as np
 
+from ..utils.faults import CorruptRecord, maybe_fault
 from .records import Record, record_has_image
 from .shard import Shard
+
+
+class PrefetchError(RuntimeError):
+    """The prefetch producer died or stalled; the batch stream is
+    broken (distinct from StopIteration = clean end of data)."""
+
+
+@dataclass
+class PipelineStats:
+    """Shared counters between a batch source, its Prefetcher, and the
+    consumer (trainer/supervisor) — chiefly the quarantine tally of
+    corrupt records skipped instead of crashing the run."""
+    quarantined: int = 0        # total corrupt records skipped
+    quarantined_pass: int = 0   # within the current read pass
+    passes: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False)
+
+    def quarantine(self, n: int = 1) -> None:
+        with self._lock:
+            self.quarantined += n
+            self.quarantined_pass += n
+
+    def end_pass(self) -> int:
+        """Close the current pass; returns (and resets) its quarantine
+        count so sources can log once per pass."""
+        with self._lock:
+            n = self.quarantined_pass
+            self.quarantined_pass = 0
+            self.passes += 1
+            return n
 
 
 def _decode_batch(vals: List[bytes], data_layer: str) -> Dict:
@@ -38,9 +81,19 @@ def _decode_batch(vals: List[bytes], data_layer: str) -> Dict:
                          "label": np.asarray(labels, np.int32)}}
 
 
+def _quarantine_pass_report(source: str, stats: PipelineStats) -> None:
+    n = stats.end_pass()
+    if n:
+        import sys
+        print(f"warning: quarantined {n} corrupt record(s) in one pass "
+              f"over {source} ({stats.quarantined} total)",
+              file=sys.stderr)
+
+
 def lmdb_batches(path: str, batchsize: int, data_layer: str = "data",
                  loop: bool = True, random_skip: int = 0,
-                 seed: int = 0) -> Iterator[Dict]:
+                 seed: int = 0,
+                 stats: Optional[PipelineStats] = None) -> Iterator[Dict]:
     """Batches straight from an LMDB environment of caffe Datum values
     (kLMDBData semantics, layer.cc:237-328): B-tree key order, Datum →
     Record conversion, same random_skip contract as shard_batches.
@@ -51,6 +104,7 @@ def lmdb_batches(path: str, batchsize: int, data_layer: str = "data",
     from .lmdb_reader import iter_lmdb
     from .records import Datum, record_from_datum
 
+    stats = stats if stats is not None else PipelineStats()
     rng = np.random.default_rng(seed)
     # [0, random_skip-1], the reference's rand() % random_skip_
     # contract (layer.cc:651-653)
@@ -68,7 +122,17 @@ def lmdb_batches(path: str, batchsize: int, data_layer: str = "data",
                 skip -= 1
                 skipped += 1
                 continue
-            rec = record_from_datum(Datum.decode(raw))
+            try:
+                maybe_fault("data.decode")
+                d = Datum.decode(raw)
+            except (ValueError, IndexError, CorruptRecord):
+                # a single rotten Datum must not kill a million-record
+                # pass; quarantine it (counted, reported per pass)
+                stats.quarantine()
+                continue
+            # NOT quarantined: a *valid* Datum this build cannot use
+            # (e.g. JPEG-encoded) is a config error and fails loud
+            rec = record_from_datum(d)
             if rec.image is None or not (rec.image.pixel
                                          or rec.image.data):
                 continue
@@ -77,6 +141,7 @@ def lmdb_batches(path: str, batchsize: int, data_layer: str = "data",
             if len(vals) == batchsize:
                 yield _decode_batch(vals, data_layer)
                 vals = []
+        _quarantine_pass_report(f"LMDB environment {path!r}", stats)
         _pass_end_guard(f"LMDB environment {path!r}", loop, usable,
                         skipped, seen, warned)
         if not loop:
@@ -112,9 +177,14 @@ def _pass_end_guard(source: str, loop: bool, usable: int, skipped: int,
 
 def shard_batches(folder: str, batchsize: int, data_layer: str = "data",
                   loop: bool = True, random_skip: int = 0,
-                  seed: int = 0) -> Iterator[Dict]:
+                  seed: int = 0,
+                  stats: Optional[PipelineStats] = None) -> Iterator[Dict]:
     """Batches from a shard folder of Record tuples, in file order
-    (ShardData semantics, layer.cc:646-673 incl. random_skip)."""
+    (ShardData semantics, layer.cc:646-673 incl. random_skip).  Records
+    whose bytes fail the tag-walk (torn mid-file writes the append-scan
+    cannot truncate) are quarantined into `stats`, not raised — the
+    shard's own torn-TAIL recovery already ran at open."""
+    stats = stats if stats is not None else PipelineStats()
     rng = np.random.default_rng(seed)
     # [0, random_skip-1], the reference's rand() % random_skip_
     # contract (layer.cc:651-653)
@@ -126,20 +196,31 @@ def shard_batches(folder: str, batchsize: int, data_layer: str = "data",
     while True:
         shard = Shard(folder, Shard.KREAD)
         usable = skipped = seen = 0
-        for i, (_, val) in enumerate(shard):
-            seen += 1
-            if skip > 0:
-                skip -= 1
-                skipped += 1
-                continue
-            if not record_has_image(val):
-                continue   # type-only records contribute no batch row
-            usable += 1
-            vals.append(val)
-            if len(vals) == batchsize:
-                yield _decode_batch(vals, data_layer)
-                vals = []
-        shard.close()
+        try:
+            for i, (_, val) in enumerate(shard):
+                seen += 1
+                if skip > 0:
+                    skip -= 1
+                    skipped += 1
+                    continue
+                try:
+                    maybe_fault("data.decode")
+                    has_image = record_has_image(val)
+                except (ValueError, CorruptRecord):
+                    stats.quarantine()
+                    continue
+                if not has_image:
+                    continue   # type-only records contribute no batch row
+                usable += 1
+                vals.append(val)
+                if len(vals) == batchsize:
+                    yield _decode_batch(vals, data_layer)
+                    vals = []
+        finally:
+            # an abandoned generator (consumer dropped mid-pass) must
+            # not leak the file handle
+            shard.close()
+        _quarantine_pass_report(f"shard folder {folder!r}", stats)
         _pass_end_guard(f"shard folder {folder!r}", loop, usable,
                         skipped, seen, warned)
         if not loop:
@@ -150,26 +231,70 @@ def shard_batches(folder: str, batchsize: int, data_layer: str = "data",
 
 class Prefetcher:
     """Bounded background prefetch (the reference's prefetch thread,
-    worker.cc:163-177, generalized to a queue depth)."""
+    worker.cc:163-177, generalized to a queue depth).
+
+    Failure contract:
+    - an exception in the producer thread is re-raised on the consumer
+      side (a corrupt source must not look like a clean end of data);
+    - the consumer polls with a timeout and checks producer liveness,
+      so a producer that died without signaling raises PrefetchError
+      instead of hanging the trainer forever; `stall_timeout` bounds
+      the wait on a live-but-stuck producer (None = unbounded);
+    - `close()` (also driven by `__del__` and iterator drop) stops the
+      producer and drains the queue so the daemon thread exits instead
+      of blocking on a full queue for the life of the process;
+    - an injected CorruptRecord at the `data.decode` site is
+      quarantined into `stats` (the batch stream continues, in order).
+    """
 
     _END = object()
 
-    def __init__(self, it: Iterator, depth: int = 2):
+    def __init__(self, it: Iterator, depth: int = 2,
+                 poll_timeout: float = 0.5,
+                 stall_timeout: Optional[float] = None,
+                 stats: Optional[PipelineStats] = None):
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._it = it
         self._err: Optional[BaseException] = None
         self._done = False
+        self._poll = max(poll_timeout, 0.01)
+        self._stall = stall_timeout
+        self.stats = stats if stats is not None else PipelineStats()
+        self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
+    def _put(self, item) -> bool:
+        """Blocking put that still honors close(): gives up when the
+        consumer asked us to stop (the queue may be full forever)."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=self._poll)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def _run(self):
         try:
-            for item in self._it:
-                self._q.put(item)
+            while not self._stop.is_set():
+                try:
+                    maybe_fault("data.decode")
+                except CorruptRecord:
+                    # the bad record is consumed and counted; the next
+                    # good one takes its slot, order preserved
+                    self.stats.quarantine()
+                    continue
+                try:
+                    item = next(self._it)
+                except StopIteration:
+                    break
+                if not self._put(item):
+                    return   # closed: no sentinel needed, nobody reads
         except BaseException as e:  # re-raised on the consumer thread —
-            self._err = e           # a corrupt record must not look like
+            self._err = e           # a corrupt source must not look like
         finally:                    # a clean end of data
-            self._q.put(self._END)
+            self._put(self._END)
 
     def __iter__(self):
         return self
@@ -179,12 +304,59 @@ class Prefetcher:
             if self._err is not None:
                 raise self._err
             raise StopIteration
-        item = self._q.get()
+        maybe_fault("data.prefetch")
+        deadline = (time.monotonic() + self._stall
+                    if self._stall is not None else None)
+        while True:
+            try:
+                item = self._q.get(timeout=self._poll)
+                break
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    # drain race: the sentinel may have landed between
+                    # the timeout and the liveness check
+                    try:
+                        item = self._q.get_nowait()
+                        break
+                    except queue.Empty:
+                        self._done = True
+                        if self._err is not None:
+                            raise self._err
+                        raise PrefetchError(
+                            "prefetch producer thread died without "
+                            "signaling end of data")
+                if deadline is not None and time.monotonic() > deadline:
+                    raise PrefetchError(
+                        f"prefetch stalled: no batch for "
+                        f"{self._stall:.1f}s (producer alive but "
+                        f"stuck — slow or hung data source)")
         if item is self._END:
             self._done = True
             return self.__next__()
         return item
 
+    def close(self) -> None:
+        """Stop the producer and release its thread.  Safe to call
+        multiple times and from __del__."""
+        self._stop.set()
+        # unblock a producer waiting on a full queue
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        t = getattr(self, "_thread", None)
+        if t is not None and t.is_alive():
+            t.join(timeout=2.0)
 
-def prefetch(it: Iterator, depth: int = 2) -> Iterator:
-    return Prefetcher(it, depth)
+    def __del__(self):  # pragma: no cover — GC timing
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def prefetch(it: Iterator, depth: int = 2,
+             stats: Optional[PipelineStats] = None,
+             stall_timeout: Optional[float] = None) -> Prefetcher:
+    return Prefetcher(it, depth, stats=stats, stall_timeout=stall_timeout)

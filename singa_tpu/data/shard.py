@@ -38,6 +38,7 @@ class Shard:
         self.capacity = capacity
         self._keys = set()
         self._buf = bytearray()
+        self._closed = False
         if mode == self.KREAD:
             self._f = open(self.path, "rb")
         elif mode == self.KCREATE:
@@ -52,6 +53,11 @@ class Shard:
 
     # -- write path --------------------------------------------------------
     def insert(self, key: bytes | str, val: bytes) -> bool:
+        if self._closed:
+            # writing to a dead handle would raise a bare ValueError at
+            # the next capacity flush — or worse, buffer silently until
+            # then; fail at the call site instead
+            raise ShardError(f"insert on closed shard {self.path}")
         if isinstance(key, str):
             key = key.encode()
         if key in self._keys or len(val) == 0:
@@ -105,15 +111,35 @@ class Shard:
         return n
 
     def close(self) -> None:
-        if self.mode != self.KREAD:
-            self.flush()
-        self._f.close()
+        if self._closed:
+            return
+        try:
+            if self.mode != self.KREAD:
+                self.flush()
+        finally:
+            # mark closed BEFORE the handle close so a flush failure
+            # still retires the shard (no further inserts can land in a
+            # half-flushed buffer) and close() stays idempotent
+            self._closed = True
+            self._f.close()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
 
     def __enter__(self):
         return self
 
-    def __exit__(self, *exc):
-        self.close()
+    def __exit__(self, exc_type, exc, tb):
+        try:
+            self.close()
+        except Exception:
+            # the body's exception is the one the caller must see; a
+            # flush failure on the way out must not mask it (it is
+            # ordinarily a symptom of the same underlying I/O error)
+            if exc_type is None:
+                raise
+        return False
 
     # -- crash recovery ----------------------------------------------------
     def _prepare_for_append(self) -> int:

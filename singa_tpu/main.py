@@ -41,6 +41,17 @@ def make_argparser() -> argparse.ArgumentParser:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--resume", action="store_true",
                     help="resume from latest checkpoint in the workspace")
+    ap.add_argument("--max-restarts", "--max_restarts", type=int,
+                    dest="max_restarts", default=0,
+                    help="supervise the run: on a step/pipeline failure "
+                         "restore the latest valid checkpoint, replay "
+                         "data, and retry with backoff up to N times "
+                         "(0 = unsupervised; see docs/FAULT_TOLERANCE.md)")
+    ap.add_argument("--fault_spec", default=None,
+                    help="deterministic fault injection: comma-separated "
+                         "site@visit[:kind] entries, e.g. "
+                         "'step.train@7:preempt,ckpt.save@1:torn' "
+                         "(sites/kinds in singa_tpu/utils/faults.py)")
     ap.add_argument("--workspace", default=None,
                     help="override ClusterProto.workspace")
     ap.add_argument("--scan_chunk", type=int, default=0,
@@ -56,6 +67,17 @@ def make_argparser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = make_argparser().parse_args(argv)
+    from .utils.faults import FaultSchedule, inject
+    schedule = (FaultSchedule.parse(args.fault_spec, seed=args.seed)
+                if args.fault_spec else None)
+    if schedule is not None:
+        print(f"fault injection active: {args.fault_spec} "
+              f"(seed {args.seed})")
+    with inject(schedule):
+        return _run(args)
+
+
+def _run(args) -> int:
     model = load_model_config(args.model_conf)
     cluster = (load_cluster_config(args.cluster_conf)
                if args.cluster_conf else None)
@@ -183,31 +205,6 @@ def main(argv=None) -> int:
                 f"{k} : {v:.6f}" for k, v in sorted(avg.items())))
         return 0
 
-    params, opt_state = trainer.init(seed=args.seed)
-    if mesh is not None:
-        from .parallel import shard_opt_state, shard_params
-        params = shard_params(mesh, trainer.train_net, params)
-        opt_state = shard_opt_state(mesh, trainer.train_net, opt_state)
-
-    start_step = 0
-    if args.resume:
-        if not workspace:
-            print("warning: --resume given but no workspace configured "
-                  "(set --workspace or ClusterProto.workspace); "
-                  "starting from scratch", file=sys.stderr)
-        else:
-            params, opt_state, start_step = trainer.resume(
-                params, opt_state, workspace)
-            if start_step > 0:
-                print(f"resumed from step {start_step}")
-            else:
-                print(f"no checkpoint found in {workspace}; "
-                      "starting from scratch")
-
-    train_iter, test_factory = resolve_data_source(
-        model, bs, seed=args.seed, force_synthetic=args.synthetic,
-        sample_shapes=input_shapes)
-
     if mesh is not None:
         from .parallel import (batch_shardings, seq_batch_shardings,
                                shard_batch)
@@ -219,16 +216,64 @@ def main(argv=None) -> int:
         def _sharded(it):
             for b in it:
                 yield shard_batch(mesh, b, shardings_fn=shard_fn)
+    else:
+        def _sharded(it):
+            return it
 
-        train_iter = _sharded(train_iter)
-        if test_factory is not None:
-            inner_factory = test_factory
-            test_factory = lambda: _sharded(inner_factory())  # noqa: E731
+    def make_train_iter():
+        it, _ = resolve_data_source(
+            model, bs, seed=args.seed, force_synthetic=args.synthetic,
+            sample_shapes=input_shapes)
+        return _sharded(it)
 
-    params, opt_state, history = trainer.run(
-        params, opt_state, train_iter, test_iter_factory=test_factory,
-        seed=args.seed, start_step=start_step, workspace=workspace,
-        scan_chunk=args.scan_chunk)
+    _, test_factory = resolve_data_source(
+        model, bs, seed=args.seed, force_synthetic=args.synthetic,
+        sample_shapes=input_shapes)
+    if test_factory is not None:
+        inner_factory = test_factory
+        test_factory = lambda: _sharded(inner_factory())  # noqa: E731
+
+    if args.resume and not workspace:
+        print("warning: --resume given but no workspace configured "
+              "(set --workspace or ClusterProto.workspace); "
+              "starting from scratch", file=sys.stderr)
+
+    if args.max_restarts > 0:
+        # supervised runtime: restore-the-last-valid-snapshot + replay
+        # on failure, the recovery loop the reference left as a TODO
+        # (Worker::Resume, worker.cc:65-67)
+        from .core.supervisor import Supervisor, TrainingAborted
+        sup = Supervisor(trainer, workspace,
+                         max_restarts=args.max_restarts, log=print)
+        try:
+            params, opt_state, history = sup.run(
+                make_train_iter, test_iter_factory=test_factory,
+                seed=args.seed, scan_chunk=args.scan_chunk,
+                resume=args.resume)
+        except TrainingAborted as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+    else:
+        params, opt_state = trainer.init(seed=args.seed)
+        if mesh is not None:
+            from .parallel import shard_opt_state, shard_params
+            params = shard_params(mesh, trainer.train_net, params)
+            opt_state = shard_opt_state(mesh, trainer.train_net,
+                                        opt_state)
+        start_step = 0
+        if args.resume and workspace:
+            params, opt_state, start_step = trainer.resume(
+                params, opt_state, workspace)
+            if start_step > 0:
+                print(f"resumed from step {start_step}")
+            else:
+                print(f"no checkpoint found in {workspace}; "
+                      "starting from scratch")
+        params, opt_state, history = trainer.run(
+            params, opt_state, make_train_iter(),
+            test_iter_factory=test_factory,
+            seed=args.seed, start_step=start_step, workspace=workspace,
+            scan_chunk=args.scan_chunk)
     final = trainer.perf.to_string()
     print("training done" + (f": {final}" if final else
                              f" at step {model.train_steps}"))

@@ -31,6 +31,44 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config.schema import UpdaterConfig
+from ..utils.faults import Backoff, Preemption, maybe_fault
+
+
+class SyncRoundSkipped(RuntimeError):
+    """Internal signal: a center exchange failed past its retry budget;
+    the caller degrades to 'skip this sync round'."""
+
+
+def sync_with_retries(exchange, *, attempts: int = 3,
+                      backoff: Backoff | None = None,
+                      log=print, step: int | None = None):
+    """Run a cross-slice `exchange()` with retries + exponential
+    backoff.  Cross-slice links (DCN between slices — the tier this
+    module exists for) flake in ways intra-slice ICI does not, and the
+    async algorithms tolerate a missed round by construction (EASGD /
+    RandomSync replicas drift between exchanges anyway), so a failed
+    exchange degrades to SKIPPING the round instead of killing a
+    multi-hour run.  Returns exchange()'s value, or raises
+    SyncRoundSkipped after the budget; Preemption always propagates
+    (the process is going away — retrying is pointless)."""
+    backoff = backoff or Backoff(base=0.05, cap=2.0, seed=step or 0)
+    last: BaseException | None = None
+    for k in range(max(attempts, 1)):
+        try:
+            maybe_fault("sync.elastic")
+            return exchange()
+        except Preemption:
+            raise
+        except Exception as e:  # noqa: BLE001 — transport/runtime faults
+            last = e
+            log(f"warning: cross-slice sync failed"
+                + (f" at step {step}" if step is not None else "")
+                + f" (attempt {k + 1}/{attempts}): {e}")
+            if k + 1 < attempts:
+                backoff.sleep(k)
+    raise SyncRoundSkipped(
+        f"cross-slice sync abandoned after {attempts} attempts: {last}"
+    ) from last
 
 
 def elastic_update(replica, center, alpha: float):
@@ -119,7 +157,9 @@ class ElasticController:
     """
 
     def __init__(self, cfg: UpdaterConfig, ngroups: int = 1,
-                 bandwidth_mb_s: float = 0.0, nservers: int = 1):
+                 bandwidth_mb_s: float = 0.0, nservers: int = 1,
+                 log_fn=print, sync_retries: int = 3,
+                 sync_backoff: Backoff | None = None):
         self.cfg = cfg
         self.alpha = easgd_alpha(cfg, ngroups)
         self.mode = cfg.param_type           # "Elastic" | "RandomSync"
@@ -128,6 +168,10 @@ class ElasticController:
         self.sample_ratio = 1.0
         self.bandwidth_mb_s = bandwidth_mb_s
         self.nservers = max(nservers, 1)
+        self.log = log_fn
+        self.sync_retries = max(sync_retries, 1)
+        self.sync_backoff = sync_backoff
+        self.skipped_rounds = 0
 
     def configure_sync(self, compute_time_s: float,
                        model_size_floats: int, nworkers: int) -> None:
@@ -167,11 +211,29 @@ class ElasticController:
                 # its first delta baseline is its own current params
                 self.snapshot = jax.tree_util.tree_map(jnp.copy, params)
             rng = rng if rng is not None else jax.random.PRNGKey(step)
-            params, self.center, self.snapshot = randomsync_update(
-                params, self.center, self.snapshot, self.sample_ratio, rng)
+
+            def exchange():
+                return randomsync_update(params, self.center,
+                                         self.snapshot,
+                                         self.sample_ratio, rng)
         else:
-            params, self.center = elastic_update(params, self.center,
-                                                 self.alpha)
+            def exchange():
+                return elastic_update(params, self.center, self.alpha)
+        try:
+            out = sync_with_retries(exchange, attempts=self.sync_retries,
+                                    backoff=self.sync_backoff,
+                                    log=self.log, step=step)
+        except SyncRoundSkipped as e:
+            # the replica keeps training on its own params; the next
+            # cadence step exchanges a (larger) delta as usual
+            self.skipped_rounds += 1
+            self.log(f"warning: skipping sync round at step {step} "
+                     f"({e}); replica continues un-synced")
+            return params
+        if self.mode == "RandomSync":
+            params, self.center, self.snapshot = out
+        else:
+            params, self.center = out
         return params
 
 
@@ -309,6 +371,8 @@ class DistributedReplicaSet:
         self.params, self.opt = trainer.init(seed=seed)
         self._mesh = self._group_mesh()
         self._exchange = None
+        self.sync_retries = 3
+        self.skipped_rounds = 0
 
     def _group_mesh(self):
         from jax.sharding import Mesh
@@ -476,7 +540,18 @@ class DistributedReplicaSet:
             self.params, self.opt, metrics = self.trainer.train_step(
                 self.params, self.opt, batch, step, step_rng)
             if self._sync_now(step):
-                self._sync(step, rng)
+                # every process must make the same skip/retry decision
+                # or the collective exchange deadlocks; a failed DCN
+                # collective raises on ALL participants, and the seeded
+                # backoff keys on `step`, so the decision is symmetric
+                try:
+                    sync_with_retries(lambda: self._sync(step, rng),
+                                      attempts=self.sync_retries,
+                                      step=step)
+                except SyncRoundSkipped as e:
+                    self.skipped_rounds += 1
+                    print(f"warning: skipping sync round at step "
+                          f"{step} ({e}); replica continues un-synced")
             history.append({k: float(v) for k, v in metrics.items()})
             if hooks:
                 for h in hooks:

@@ -1,2 +1,4 @@
 from .checkpoint import CheckpointManager, load_pretrained
+from .faults import (Backoff, CorruptRecord, FaultError, FaultSchedule,
+                     FaultSpec, Preemption, inject, maybe_fault)
 from .profiler import trace, StepTimer, flops_of

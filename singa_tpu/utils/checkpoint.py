@@ -8,15 +8,29 @@ Backed by orbax (the TPU-native checkpoint format: sharded-array aware,
 atomic renames).  A checkpoint holds {params, opt_state, step} — the
 same state triple the reference intended to snapshot (Param data_ +
 history_ + step).
+
+Hardening (the failure-recovery tier the reference never shipped):
+the no-orbax fallback writes tmp-file + atomic rename and records a
+sha256 per snapshot in a checksummed MANIFEST.json (itself written
+atomically); `restore` verifies the requested snapshot and *walks back*
+to the previous good one past any corrupt/partial/unreadable snapshot
+instead of crashing the resume — on both the orbax and fallback paths.
+Save/restore consult the `ckpt.save` / `ckpt.restore` fault-injection
+sites (utils.faults), so torn writes and restore failures are testable
+on CPU.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
+
+from . import faults
 
 try:
     import orbax.checkpoint as ocp
@@ -31,17 +45,54 @@ except Exception:  # pragma: no cover
 #   2 — NHWC vision stack (vdim ordered (H, W, C), commit dd2e3aa)
 LAYOUT_VERSION = 2
 
+_MANIFEST = "MANIFEST.json"
+
 
 class LayoutMismatchError(RuntimeError):
     pass
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _tear(path: str) -> None:
+    """Simulate a torn write (fault kind "torn"): truncate the snapshot
+    to half — a save that returned success but left garbage on disk
+    (lost page cache, dying disk).  On a directory (orbax layout) the
+    largest file inside is torn."""
+    if os.path.isdir(path):
+        files = [os.path.join(r, f) for r, _, fs in os.walk(path)
+                 for f in fs]
+        if not files:
+            return
+        path = max(files, key=os.path.getsize)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size // 2)
 
 
 class CheckpointManager:
     """Save/restore the training state triple under `workspace/checkpoints`
     (the reference's ClusterProto.workspace layout, cluster.proto:10-12)."""
 
-    def __init__(self, workspace: str, max_to_keep: int = 3):
+    def __init__(self, workspace: str, max_to_keep: int = 3,
+                 log_fn=print):
         self.dir = os.path.abspath(os.path.join(workspace, "checkpoints"))
+        self.log = log_fn
         os.makedirs(self.dir, exist_ok=True)
         if _HAVE_ORBAX:
             self._mgr = ocp.CheckpointManager(
@@ -77,6 +128,48 @@ class CheckpointManager:
                 f"in singa_tpu/utils/checkpoint.py); re-train or "
                 f"convert the checkpoint")
 
+    # -- manifest (no-orbax fallback) --------------------------------------
+    def _manifest_path(self) -> str:
+        return os.path.join(self.dir, _MANIFEST)
+
+    def _read_manifest(self) -> Dict[str, Any]:
+        try:
+            with open(self._manifest_path()) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return {}
+        except (json.JSONDecodeError, OSError) as e:
+            # a corrupt manifest must not take every snapshot with it:
+            # entries degrade to "legacy" (load-verified only)
+            self.log(f"warning: checkpoint manifest unreadable ({e}); "
+                     f"verifying snapshots by load only")
+            return {}
+
+    def _manifest_record(self, step: int, path: str) -> None:
+        man = self._read_manifest()
+        man[os.path.basename(path)] = {
+            "step": step,
+            "size": os.path.getsize(path),
+            "sha256": _sha256_file(path),
+        }
+        _atomic_write(self._manifest_path(),
+                      json.dumps(man, indent=1, sort_keys=True).encode())
+
+    def _verify_fallback(self, step: int) -> Optional[str]:
+        """Path of a checksum-clean snapshot for `step`, else None
+        (missing / size or sha mismatch).  Snapshots with no manifest
+        entry (pre-manifest checkpoints) pass here and are verified by
+        the np.load in restore."""
+        path = os.path.join(self.dir, f"step_{step}.npz")
+        if not os.path.exists(path):
+            return None
+        entry = self._read_manifest().get(os.path.basename(path))
+        if entry is not None:
+            if (os.path.getsize(path) != entry.get("size")
+                    or _sha256_file(path) != entry.get("sha256")):
+                return None
+        return path
+
     def save(self, step: int, params: Dict[str, Any],
              opt_state: Dict[str, Any]) -> None:
         if self.latest_step() is not None:
@@ -84,34 +177,86 @@ class CheckpointManager:
             # a workspace still holding older-layout checkpoints would
             # retroactively bless them (the marker is per-directory)
             self._check_version()
+        act = faults.maybe_fault("ckpt.save")
         state = {"params": params, "opt_state": opt_state,
                  "step": np.asarray(step)}
         if self._mgr is not None:
             self._mgr.save(step, args=ocp.args.StandardSave(state))
             self._mgr.wait_until_finished()
-        else:  # numpy fallback
+            if act == "torn":
+                _tear(os.path.join(self.dir, str(step)))
+                return   # crash before the version stamp
+        else:
             path = os.path.join(self.dir, f"step_{step}.npz")
             flat = _flatten("", state)
-            np.savez(path, **{k: np.asarray(v) for k, v in flat.items()})
+            arrays = {k: np.asarray(v) for k, v in flat.items()}
+            # tmp + atomic rename: a crash mid-write leaves a *.tmp the
+            # reader never lists, not a torn step_N.npz that a resume
+            # would trip over (the reference's shard store solved the
+            # same problem by truncating torn tails, shard.cc:175-206)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                np.savez(f, **arrays)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            if act == "torn":
+                # the rename "succeeded" but the data pages never hit
+                # the platter; no manifest entry either (crash before)
+                _tear(path)
+                return
+            self._manifest_record(step, path)
         # stamp only after a successful save: a failed save must not
         # mark the directory as holding current-layout checkpoints
         self._write_version()
 
-    def latest_step(self) -> Optional[int]:
+    def available_steps(self) -> List[int]:
+        """All snapshot steps present on disk, ascending (valid or not —
+        restore decides validity)."""
         if self._mgr is not None:
-            return self._mgr.latest_step()
-        steps = [int(f[5:-4]) for f in os.listdir(self.dir)
-                 if f.startswith("step_") and f.endswith(".npz")]
-        return max(steps) if steps else None
+            return sorted(self._mgr.all_steps())
+        return sorted(int(f[5:-4]) for f in os.listdir(self.dir)
+                      if f.startswith("step_") and f.endswith(".npz"))
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.available_steps()
+        return steps[-1] if steps else None
 
     def restore(self, step: Optional[int] = None,
                 template: Optional[Dict[str, Any]] = None
                 ) -> Optional[Tuple[Dict, Dict, int]]:
-        """Returns (params, opt_state, step) or None if no checkpoint."""
-        step = step if step is not None else self.latest_step()
-        if step is None:
+        """Returns (params, opt_state, step) or None if no checkpoint.
+
+        A corrupt/partial/unreadable snapshot at the requested (or
+        latest) step does not fail the resume: it is logged and skipped,
+        and the next older snapshot is tried — the previous *good*
+        checkpoint wins (TrainingAborted only when none is loadable)."""
+        steps = self.available_steps()
+        if step is not None:
+            steps = [s for s in steps if s <= step]
+        if not steps:
             return None
         self._check_version()
+        faults.maybe_fault("ckpt.restore")
+        for s in reversed(steps):
+            try:
+                out = self._restore_one(s, template)
+            except LayoutMismatchError:
+                raise
+            except Exception as e:  # noqa: BLE001 — any torn snapshot
+                self.log(f"warning: checkpoint step {s} is corrupt or "
+                         f"partial ({type(e).__name__}: {e}); skipping "
+                         f"to the previous snapshot")
+                continue
+            if out is not None:
+                return out
+        self.log(f"warning: no restorable checkpoint among steps "
+                 f"{steps} in {self.dir}")
+        return None
+
+    def _restore_one(self, step: int,
+                     template: Optional[Dict[str, Any]]
+                     ) -> Optional[Tuple[Dict, Dict, int]]:
         if self._mgr is not None:
             if template is not None:
                 target = {"params": template["params"],
@@ -122,9 +267,12 @@ class CheckpointManager:
             else:
                 state = self._mgr.restore(step)
             return state["params"], state["opt_state"], int(state["step"])
-        path = os.path.join(self.dir, f"step_{step}.npz")
+        path = self._verify_fallback(step)
+        if path is None:
+            raise IOError(f"snapshot step_{step}.npz missing or "
+                          f"checksum mismatch vs manifest")
         data = np.load(path)
-        state = _unflatten(dict(data.items()))
+        state = _unflatten({k: data[k] for k in data.files})
         return state["params"], state["opt_state"], int(state["step"])
 
 

@@ -1,0 +1,247 @@
+"""Deterministic fault injection for the training runtime.
+
+The reference designed failure recovery but never shipped it
+(Worker::Resume is an empty TODO, worker.cc:65-67) — partly because a
+recovery path you cannot trigger on demand is a recovery path you never
+test.  This module makes every failure mode reproducible on CPU: a
+seeded `FaultSchedule` fires exceptions (or simulated preemptions, or
+silent data corruption) at named *sites* instrumented throughout the
+runtime, so tests and `scripts/fault_smoke.sh` can kill a run at step k,
+tear a checkpoint, or corrupt one record and assert the supervisor
+recovers to the exact uninterrupted trajectory.
+
+Sites (each `maybe_fault(site)` call is one *visit*; visits are counted
+per site across the whole process, including replayed steps after a
+restart — so a one-shot fault never re-fires during recovery):
+
+    data.decode    one record decoded (Prefetcher producer / shard read)
+    data.prefetch  one batch handed to the consumer (Prefetcher.__next__)
+    ckpt.save      one checkpoint save (before finalize)
+    ckpt.restore   one checkpoint restore attempt
+    sync.elastic   one cross-slice center exchange (elastic/randomsync)
+    step.train     one training-loop iteration (Trainer.run / run_cd)
+
+Fault kinds:
+
+    error    raise FaultError (a generic failure at the site)
+    preempt  raise Preemption (the job is killed; a Supervisor treats it
+             exactly like a SIGTERM'd process that restarts)
+    corrupt  raise CorruptRecord (data sites: the record is bad; the
+             pipeline quarantines it and continues)
+    torn     no exception — maybe_fault returns "torn" and the SITE
+             decides how to honor it (ckpt.save writes a truncated
+             snapshot: a save that "succeeded" but left garbage on disk)
+
+Instrumented code calls `maybe_fault(site)` — a no-op returning None
+unless a schedule is active via `inject(schedule)`.  Overhead when
+inactive is one global read.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+SITES = ("data.decode", "data.prefetch", "ckpt.save", "ckpt.restore",
+         "sync.elastic", "step.train")
+
+KINDS = ("error", "preempt", "corrupt", "torn")
+
+
+class FaultError(RuntimeError):
+    """A generic injected failure at a site."""
+
+
+class Preemption(FaultError):
+    """A simulated preemption: the run is killed at this point.  The
+    Supervisor treats it like any crash — restore + replay — but keeps
+    it distinct in the failure log (preemptions are expected on
+    preemptible TPU slices; repeated *errors* are a bug)."""
+
+
+class CorruptRecord(FaultError):
+    """An injected bad data record; the pipeline quarantines it (skips
+    and counts) instead of failing the run."""
+
+
+_KIND_EXC = {"error": FaultError, "preempt": Preemption,
+             "corrupt": CorruptRecord}
+
+
+@dataclass
+class FaultSpec:
+    """Fire `kind` at the `at`-th visit (0-based) of `site`, once."""
+    site: str
+    at: int
+    kind: str = "error"
+    fired: bool = False
+
+    def __post_init__(self):
+        if self.site not in SITES:
+            raise ValueError(f"unknown fault site {self.site!r}; "
+                             f"sites are {SITES}")
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; "
+                             f"kinds are {KINDS}")
+
+
+@dataclass
+class FiredFault:
+    site: str
+    visit: int
+    kind: str
+    time: float
+
+
+class FaultSchedule:
+    """Deterministic per-site fault plan: one-shot `FaultSpec`s plus
+    optional seeded per-visit probabilities (`rates`, site -> p) for
+    chaos runs.  Thread-safe — the prefetch producer thread and the
+    training loop consult the same schedule."""
+
+    def __init__(self, specs: Optional[List[FaultSpec]] = None,
+                 rates: Optional[Dict[str, float]] = None,
+                 rate_kind: str = "error", seed: int = 0):
+        import numpy as np
+        self.specs = list(specs or [])
+        self.rates = dict(rates or {})
+        for site in self.rates:
+            if site not in SITES:
+                raise ValueError(f"unknown fault site {site!r}")
+        if rate_kind not in KINDS:
+            raise ValueError(f"unknown fault kind {rate_kind!r}")
+        self.rate_kind = rate_kind
+        self._rng = np.random.default_rng(seed)
+        self._visits: Dict[str, int] = {}
+        self.fired: List[FiredFault] = []
+        self._lock = threading.Lock()
+
+    @classmethod
+    def parse(cls, spec: str, seed: int = 0) -> "FaultSchedule":
+        """Parse a CLI spec: comma/semicolon-separated `site@visit:kind`
+        entries, e.g. `"step.train@7:preempt,ckpt.save@1:torn"`.  The
+        kind defaults to `error`."""
+        specs = []
+        for part in spec.replace(";", ",").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            try:
+                site, rest = part.split("@", 1)
+                at, _, kind = rest.partition(":")
+                specs.append(FaultSpec(site=site.strip(), at=int(at),
+                                       kind=(kind.strip() or "error")))
+            except ValueError as e:
+                raise ValueError(
+                    f"bad fault spec entry {part!r} (want "
+                    f"site@visit[:kind]): {e}") from e
+        return cls(specs, seed=seed)
+
+    def visits(self, site: str) -> int:
+        with self._lock:
+            return self._visits.get(site, 0)
+
+    def visit(self, site: str) -> Optional[str]:
+        """Record one visit to `site`; raise / return the scheduled
+        fault if any.  Returns "torn" for the non-raising kind, None
+        otherwise."""
+        with self._lock:
+            n = self._visits.get(site, 0)
+            self._visits[site] = n + 1
+            kind = None
+            for s in self.specs:
+                if s.site == site and s.at == n and not s.fired:
+                    s.fired = True
+                    kind = s.kind
+                    break
+            if kind is None and site in self.rates:
+                if self._rng.random() < self.rates[site]:
+                    kind = self.rate_kind
+            if kind is None:
+                return None
+            self.fired.append(FiredFault(site, n, kind, time.time()))
+        if kind == "torn":
+            return "torn"
+        raise _KIND_EXC[kind](f"injected {kind} at {site} (visit {n})")
+
+
+# -- process-wide activation ----------------------------------------------
+_ACTIVE: Optional[FaultSchedule] = None
+
+
+def active() -> Optional[FaultSchedule]:
+    return _ACTIVE
+
+
+def maybe_fault(site: str) -> Optional[str]:
+    """Consult the active schedule at an instrumented site.  No-op
+    (None) when no schedule is installed."""
+    sch = _ACTIVE
+    return sch.visit(site) if sch is not None else None
+
+
+@contextmanager
+def inject(schedule: Optional[FaultSchedule]):
+    """Activate `schedule` for the dynamic extent of the block.  Nesting
+    replaces (and restores) the outer schedule; None is a no-op."""
+    global _ACTIVE
+    prev = _ACTIVE
+    _ACTIVE = schedule
+    try:
+        yield schedule
+    finally:
+        _ACTIVE = prev
+
+
+# -- retry/backoff ---------------------------------------------------------
+@dataclass
+class Backoff:
+    """Exponential backoff with seeded jitter — deterministic delays in
+    tests, decorrelated retries in a fleet (every worker hashing its
+    coordinates into `seed` avoids a retry stampede after a shared
+    outage).  delay(k) = min(cap, base * 2^k) * (1 + jitter*u),
+    u ~ U[0,1) from the seeded stream."""
+    base: float = 0.5
+    cap: float = 30.0
+    jitter: float = 0.25
+    seed: int = 0
+    _rng: object = field(default=None, repr=False)
+
+    def delay(self, attempt: int) -> float:
+        import numpy as np
+        if self._rng is None:
+            self._rng = np.random.default_rng(self.seed)
+        d = min(self.cap, self.base * (2.0 ** max(attempt, 0)))
+        return d * (1.0 + self.jitter * float(self._rng.random()))
+
+    def sleep(self, attempt: int) -> float:
+        d = self.delay(attempt)
+        if d > 0:
+            time.sleep(d)
+        return d
+
+
+def retry_call(fn, attempts: int, backoff: Backoff, log=None,
+               what: str = "operation"):
+    """Run `fn()` with up to `attempts` total tries, sleeping the
+    backoff between failures.  Preemptions are never retried here — they
+    mean the whole process is going away, so they propagate to the
+    supervisor immediately.  Returns fn()'s value, or raises the last
+    failure after the budget is spent."""
+    last: Optional[BaseException] = None
+    for k in range(max(attempts, 1)):
+        try:
+            return fn()
+        except Preemption:
+            raise
+        except Exception as e:  # noqa: BLE001 — retry any site failure
+            last = e
+            if log is not None:
+                log(f"warning: {what} failed (attempt {k + 1}/"
+                    f"{attempts}): {e}")
+            if k + 1 < attempts:
+                backoff.sleep(k)
+    raise last

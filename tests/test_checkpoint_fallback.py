@@ -1,0 +1,151 @@
+"""No-orbax checkpoint fallback hardening (ISSUE 1 satellite): atomic
+tmp+rename writes, the checksummed manifest, and restore that walks
+back past corrupt/partial snapshots to the previous good one.  Orbax is
+forcibly disabled via monkeypatch so the numpy fallback is what runs —
+the path a minimal deployment (or a CPU test box) actually exercises."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from singa_tpu.utils import checkpoint as ckpt_mod
+from singa_tpu.utils.checkpoint import CheckpointManager
+from singa_tpu.utils.faults import FaultSchedule, FaultSpec, FaultError, \
+    inject
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture
+def no_orbax(monkeypatch):
+    monkeypatch.setattr(ckpt_mod, "_HAVE_ORBAX", False)
+
+
+def _state(v):
+    return ({"w": np.full((4, 4), float(v), np.float32)},
+            {"history": {"w": np.zeros((4, 4), np.float32)}})
+
+
+def _mgr(tmp_path, logs=None):
+    return CheckpointManager(str(tmp_path),
+                             log_fn=(logs.append if logs is not None
+                                     else (lambda s: None)))
+
+
+def test_fallback_save_is_atomic_and_manifested(tmp_path, no_orbax):
+    mgr = _mgr(tmp_path)
+    mgr.save(1, *_state(1))
+    mgr.save(2, *_state(2))
+    names = sorted(os.listdir(mgr.dir))
+    assert "step_1.npz" in names and "step_2.npz" in names
+    assert not any(n.endswith(".tmp") for n in names)   # no torn leftovers
+    man = json.load(open(os.path.join(mgr.dir, "MANIFEST.json")))
+    assert set(man) == {"step_1.npz", "step_2.npz"}
+    for name, entry in man.items():
+        assert entry["size"] == os.path.getsize(
+            os.path.join(mgr.dir, name))
+        assert len(entry["sha256"]) == 64
+
+
+def test_truncated_newest_falls_back_to_previous_good(tmp_path, no_orbax):
+    """save → truncate the newest snapshot → restore returns the
+    previous good checkpoint and logs the skip (the satellite's exact
+    scenario)."""
+    logs = []
+    mgr = _mgr(tmp_path, logs)
+    mgr.save(1, *_state(1))
+    mgr.save(2, *_state(2))
+    path2 = os.path.join(mgr.dir, "step_2.npz")
+    with open(path2, "r+b") as f:
+        f.truncate(os.path.getsize(path2) // 2)
+
+    restored = _mgr(tmp_path, logs).restore()
+    assert restored is not None
+    params, opt, step = restored
+    assert step == 1
+    np.testing.assert_allclose(params["w"], 1.0)
+    np.testing.assert_allclose(opt["history"]["w"], 0.0)
+    assert any("corrupt or partial" in l and "step 2" in l for l in logs)
+
+
+def test_bitflip_detected_by_manifest_checksum(tmp_path, no_orbax):
+    """Same size, one flipped byte: only the sha256 catches it."""
+    logs = []
+    mgr = _mgr(tmp_path, logs)
+    mgr.save(1, *_state(1))
+    mgr.save(2, *_state(2))
+    path2 = os.path.join(mgr.dir, "step_2.npz")
+    size = os.path.getsize(path2)
+    with open(path2, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+    assert os.path.getsize(path2) == size
+
+    restored = _mgr(tmp_path, logs).restore()
+    assert restored is not None and restored[2] == 1
+
+
+def test_pre_manifest_snapshot_still_restores(tmp_path, no_orbax):
+    """Checkpoints written before the manifest existed (or whose
+    manifest was lost) restore on load-verification alone."""
+    mgr = _mgr(tmp_path)
+    mgr.save(3, *_state(3))
+    os.remove(os.path.join(mgr.dir, "MANIFEST.json"))
+    restored = _mgr(tmp_path).restore()
+    assert restored is not None and restored[2] == 3
+
+
+def test_all_snapshots_corrupt_returns_none(tmp_path, no_orbax):
+    logs = []
+    mgr = _mgr(tmp_path, logs)
+    mgr.save(1, *_state(1))
+    for name in os.listdir(mgr.dir):
+        if name.endswith(".npz"):
+            p = os.path.join(mgr.dir, name)
+            with open(p, "r+b") as f:
+                f.truncate(4)
+    assert _mgr(tmp_path, logs).restore() is None
+    assert any("no restorable checkpoint" in l for l in logs)
+
+
+def test_explicit_step_restore_walks_back(tmp_path, no_orbax):
+    mgr = _mgr(tmp_path)
+    mgr.save(1, *_state(1))
+    mgr.save(2, *_state(2))
+    mgr.save(3, *_state(3))
+    path2 = os.path.join(mgr.dir, "step_2.npz")
+    with open(path2, "r+b") as f:
+        f.truncate(8)
+    # asking for the corrupt step 2 lands on 1, never forward on 3
+    restored = _mgr(tmp_path).restore(step=2)
+    assert restored is not None and restored[2] == 1
+
+
+def test_torn_fault_kind_simulates_lost_pages(tmp_path, no_orbax):
+    """The `torn` fault kind at ckpt.save: the save call returns
+    success but the snapshot on disk is garbage — restore must land on
+    the previous save."""
+    mgr = _mgr(tmp_path)
+    with inject(FaultSchedule([FaultSpec("ckpt.save", 1, "torn")])):
+        mgr.save(1, *_state(1))
+        mgr.save(2, *_state(2))      # visit 1: torn on disk
+    restored = _mgr(tmp_path).restore()
+    assert restored is not None and restored[2] == 1
+
+
+def test_error_fault_during_save_preserves_previous(tmp_path, no_orbax):
+    """A crash at the start of a save (kind `error`) leaves the
+    directory exactly as it was: the previous snapshot restores."""
+    mgr = _mgr(tmp_path)
+    mgr.save(1, *_state(1))
+    with inject(FaultSchedule([FaultSpec("ckpt.save", 1, "error")])):
+        mgr.save(1, *_state(1))      # visit 0 passes (re-save)
+        with pytest.raises(FaultError):
+            mgr.save(2, *_state(2))  # visit 1 crashes before any write
+    assert not os.path.exists(os.path.join(mgr.dir, "step_2.npz"))
+    restored = _mgr(tmp_path).restore()
+    assert restored is not None and restored[2] == 1

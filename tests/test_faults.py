@@ -1,0 +1,344 @@
+"""Fault-tolerant runtime tests: deterministic fault injection
+(utils.faults), the Supervisor restore-and-replay state machine
+(core.supervisor), hardened Prefetcher/Shard/elastic failure paths.
+
+The acceptance property (ISSUE 1): a seeded schedule that preempts
+training at step k and tears one checkpoint is FULLY recovered by the
+Supervisor — resume from the last *valid* snapshot, replay data to the
+right offset, and land on step-N params identical to an uninterrupted
+run."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from singa_tpu.config.schema import UpdaterConfig, model_config_from_dict
+from singa_tpu.core.supervisor import Supervisor, TrainingAborted
+from singa_tpu.core.trainer import Trainer
+from singa_tpu.data.pipeline import (PipelineStats, PrefetchError,
+                                     Prefetcher, shard_batches)
+from singa_tpu.data.shard import Shard, ShardError
+from singa_tpu.data.synthetic import synthetic_image_batches
+from singa_tpu.utils import checkpoint as ckpt_mod
+from singa_tpu.utils.faults import (Backoff, FaultError, FaultSchedule,
+                                    FaultSpec, Preemption, inject,
+                                    maybe_fault)
+
+pytestmark = pytest.mark.faults
+
+SHAPES = {"data": {"pixel": (28, 28), "label": ()}}
+
+
+def _mlp_cfg(train_steps=12, ckpt_freq=4):
+    return model_config_from_dict({
+        "name": "faults-mlp", "train_steps": train_steps,
+        "checkpoint_frequency": ckpt_freq,
+        "updater": {"type": "kSGD", "base_learning_rate": 0.01,
+                    "learning_rate_change_method": "kFixed"},
+        "neuralnet": {"layer": [
+            {"name": "data", "type": "kShardData",
+             "data_param": {"batchsize": 8}},
+            {"name": "mnist", "type": "kMnistImage", "srclayers": "data",
+             "mnist_param": {"norm_a": 255.0}},
+            {"name": "label", "type": "kLabel", "srclayers": "data"},
+            {"name": "ip1", "type": "kInnerProduct", "srclayers": "mnist",
+             "inner_product_param": {"num_output": 16},
+             "param": [{"name": "w1",
+                        "init_method": "kUniformSqrtFanIn"},
+                       {"name": "b1"}]},
+            {"name": "ip2", "type": "kInnerProduct", "srclayers": "ip1",
+             "inner_product_param": {"num_output": 10},
+             "param": [{"name": "w2",
+                        "init_method": "kUniformSqrtFanIn"},
+                       {"name": "b2"}]},
+            {"name": "loss", "type": "kSoftmaxLoss",
+             "srclayers": ["ip2", "label"]}]}})
+
+
+def _data_factory():
+    # deterministic batch sequence: a fresh generator replays the same
+    # stream, which is what lets restore-at-step-s + skip-s reproduce
+    # the uninterrupted trajectory exactly
+    return synthetic_image_batches(8, seed=3, stream_seed=104)
+
+
+_NO_WAIT = Backoff(base=0.0, cap=0.0, jitter=0.0)
+
+
+# -- FaultSchedule ---------------------------------------------------------
+def test_fault_schedule_parse_fires_once_at_visit():
+    sch = FaultSchedule.parse("step.train@2:preempt, ckpt.save@0")
+    with inject(sch):
+        assert maybe_fault("step.train") is None      # visit 0
+        assert maybe_fault("step.train") is None      # visit 1
+        with pytest.raises(Preemption):
+            maybe_fault("step.train")                 # visit 2 fires
+        assert maybe_fault("step.train") is None      # one-shot
+        with pytest.raises(FaultError):
+            maybe_fault("ckpt.save")                  # default kind
+    assert maybe_fault("step.train") is None          # inactive outside
+    assert sch.visits("step.train") == 4
+    assert [f.kind for f in sch.fired] == ["preempt", "error"]
+
+
+def test_fault_schedule_rejects_unknown_site_and_kind():
+    with pytest.raises(ValueError, match="unknown fault site"):
+        FaultSchedule.parse("data.nope@1")
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultSpec(site="step.train", at=0, kind="explode")
+
+
+def test_fault_schedule_seeded_rates_deterministic():
+    fires = []
+    for _ in range(2):
+        sch = FaultSchedule(rates={"data.prefetch": 0.5}, seed=42)
+        hits = []
+        for i in range(20):
+            try:
+                sch.visit("data.prefetch")
+            except FaultError:
+                hits.append(i)
+        fires.append(hits)
+    assert fires[0] == fires[1] and 0 < len(fires[0]) < 20
+
+
+# -- Supervisor acceptance -------------------------------------------------
+def test_supervisor_recovers_preemption_and_torn_checkpoint(
+        tmp_path, monkeypatch):
+    """Preempt at step 10 with the step-8 snapshot torn on disk: the
+    Supervisor must restore the step-4 snapshot (the last VALID one),
+    fast-forward the data stream, and finish with params identical to
+    an uninterrupted run."""
+    monkeypatch.setattr(ckpt_mod, "_HAVE_ORBAX", False)
+
+    tr0 = Trainer(_mlp_cfg(), SHAPES, log_fn=lambda s: None, donate=False)
+    p, o = tr0.init(seed=0)
+    p_ref, _, _ = tr0.run(p, o, _data_factory(), seed=0)
+
+    logs = []
+    tr1 = Trainer(_mlp_cfg(), SHAPES, log_fn=logs.append, donate=False)
+    # cadence saves: step 4 = visit 0, step 8 = visit 1 (torn);
+    # step.train visit 10 = the loop iteration that would run step 10
+    sched = FaultSchedule([FaultSpec("ckpt.save", 1, "torn"),
+                           FaultSpec("step.train", 10, "preempt")])
+    sup = Supervisor(tr1, str(tmp_path), max_restarts=2,
+                     backoff=_NO_WAIT, log=logs.append)
+    with inject(sched):
+        p_sup, _, _ = sup.run(_data_factory, seed=0)
+
+    for k in p_ref:
+        assert np.all(np.isfinite(np.asarray(p_ref[k]))), k
+        np.testing.assert_allclose(np.asarray(p_sup[k]),
+                                   np.asarray(p_ref[k]),
+                                   rtol=0, atol=0, err_msg=k)
+    assert [f.kind for f in sup.failures] == ["preemption"]
+    assert any("resumed from step 4" in l for l in logs), logs
+    assert any("corrupt or partial" in l for l in logs), logs
+    # both fault specs actually fired
+    assert sorted(f.site for f in sched.fired) == \
+        ["ckpt.save", "step.train"]
+
+
+def test_supervisor_transient_error_backs_off_and_recovers(tmp_path):
+    """A one-shot step failure (flaky data read): restore + replay with
+    backoff still reaches the uninterrupted trajectory — including on
+    the orbax checkpoint path when available."""
+    tr0 = Trainer(_mlp_cfg(train_steps=6, ckpt_freq=2), SHAPES,
+                  log_fn=lambda s: None, donate=False)
+    p, o = tr0.init(seed=0)
+    p_ref, _, _ = tr0.run(p, o, _data_factory(), seed=0)
+
+    tr1 = Trainer(_mlp_cfg(train_steps=6, ckpt_freq=2), SHAPES,
+                  log_fn=lambda s: None, donate=False)
+    sched = FaultSchedule([FaultSpec("step.train", 3, "error")])
+    sup = Supervisor(tr1, str(tmp_path), max_restarts=2,
+                     backoff=Backoff(base=0.01, cap=0.02, seed=1),
+                     log=lambda s: None)
+    t0 = time.monotonic()
+    with inject(sched):
+        p_sup, _, _ = sup.run(_data_factory, seed=0)
+    assert time.monotonic() - t0 >= 0.01        # backoff actually slept
+    for k in p_ref:
+        assert np.all(np.isfinite(np.asarray(p_ref[k]))), k
+        np.testing.assert_allclose(np.asarray(p_sup[k]),
+                                   np.asarray(p_ref[k]),
+                                   rtol=0, atol=0, err_msg=k)
+    assert [f.kind for f in sup.failures] == ["error"]
+
+
+def test_supervisor_budget_exhausted_raises_structured(tmp_path):
+    tr = Trainer(_mlp_cfg(train_steps=4, ckpt_freq=2), SHAPES,
+                 log_fn=lambda s: None, donate=False)
+    sup = Supervisor(tr, str(tmp_path), max_restarts=2,
+                     backoff=_NO_WAIT, log=lambda s: None)
+    # every loop iteration fails: the budget must stop the crash loop
+    sched = FaultSchedule(rates={"step.train": 1.0}, seed=0)
+    with inject(sched), pytest.raises(TrainingAborted) as ei:
+        sup.run(_data_factory, seed=0)
+    aborted = ei.value
+    assert len(aborted.failures) == 3           # first try + 2 restarts
+    assert all(f.kind == "error" for f in aborted.failures)
+    assert "restart budget" in str(aborted)
+    assert "attempt 1" in str(aborted)          # log is in the message
+
+
+def test_supervisor_without_workspace_replays_from_zero():
+    tr0 = Trainer(_mlp_cfg(train_steps=4, ckpt_freq=0), SHAPES,
+                  log_fn=lambda s: None, donate=False)
+    p, o = tr0.init(seed=0)
+    p_ref, _, _ = tr0.run(p, o, _data_factory(), seed=0)
+
+    logs = []
+    tr1 = Trainer(_mlp_cfg(train_steps=4, ckpt_freq=0), SHAPES,
+                  log_fn=logs.append, donate=False)
+    sup = Supervisor(tr1, workspace=None, max_restarts=1,
+                     backoff=_NO_WAIT, log=logs.append)
+    with inject(FaultSchedule([FaultSpec("step.train", 2, "error")])):
+        p_sup, _, _ = sup.run(_data_factory, seed=0)
+    for k in p_ref:
+        assert np.all(np.isfinite(np.asarray(p_ref[k]))), k
+        np.testing.assert_allclose(np.asarray(p_sup[k]),
+                                   np.asarray(p_ref[k]),
+                                   rtol=0, atol=0, err_msg=k)
+    assert any("no workspace" in l for l in logs)
+
+
+# -- Prefetcher hardening --------------------------------------------------
+def test_prefetcher_dead_producer_raises_not_hangs():
+    class DeadProducer(Prefetcher):
+        def _run(self):   # dies without sentinel or error
+            return
+
+    it = DeadProducer(iter([1, 2]), poll_timeout=0.05)
+    it._thread.join(timeout=2.0)
+    with pytest.raises(PrefetchError, match="died"):
+        next(it)
+
+
+def test_prefetcher_stall_timeout_bounds_the_wait():
+    release = threading.Event()
+
+    def slow():
+        yield 1
+        release.wait(10.0)
+        yield 2
+
+    it = Prefetcher(slow(), poll_timeout=0.05, stall_timeout=0.3)
+    assert next(it) == 1
+    with pytest.raises(PrefetchError, match="stalled"):
+        next(it)
+    release.set()
+    it.close()
+
+
+def test_prefetcher_quarantines_injected_corrupt_records():
+    sched = FaultSchedule([FaultSpec("data.decode", 1, "corrupt")])
+    with inject(sched):
+        it = Prefetcher(iter(range(5)), poll_timeout=0.05)
+        got = list(it)
+    # order preserved, nothing dropped, the bad record counted
+    assert got == [0, 1, 2, 3, 4]
+    assert it.stats.quarantined == 1
+
+
+def test_prefetcher_close_unblocks_full_queue():
+    it = Prefetcher(iter(range(1000)), depth=1, poll_timeout=0.05)
+    assert next(it) == 0
+    it.close()
+    assert not it._thread.is_alive()
+
+
+def test_shard_batches_quarantines_corrupt_record(tmp_path):
+    from test_data import make_record
+    with Shard(str(tmp_path), Shard.KCREATE) as sh:
+        for i in range(8):
+            rec, _ = make_record(i % 3, side=4, seed=i)
+            sh.insert(f"r{i:03d}", rec.encode())
+        # a record whose bytes fail the protobuf tag-walk
+        sh.insert("rbad", b"\x12\xff")
+    stats = PipelineStats()
+    batches = list(shard_batches(str(tmp_path), batchsize=4, loop=False,
+                                 stats=stats))
+    assert sum(b["data"]["pixel"].shape[0] for b in batches) == 8
+    assert stats.quarantined == 1
+    assert stats.passes == 1
+
+
+# -- Shard close semantics -------------------------------------------------
+def test_shard_exit_flushes_when_body_raises(tmp_path):
+    from test_data import make_record
+    rec, _ = make_record(1, side=4, seed=0)
+    with pytest.raises(RuntimeError, match="boom"):
+        with Shard(str(tmp_path), Shard.KCREATE) as sh:
+            sh.insert("k0", rec.encode())
+            raise RuntimeError("boom")
+    assert sh.closed
+    rd = Shard(str(tmp_path), Shard.KREAD)
+    assert rd.count() == 1     # the insert survived the crashed body
+    rd.close()
+
+
+def test_shard_insert_after_close_raises(tmp_path):
+    sh = Shard(str(tmp_path), Shard.KCREATE)
+    sh.insert("k", b"\x01")
+    sh.close()
+    sh.close()                 # idempotent
+    with pytest.raises(ShardError, match="closed"):
+        sh.insert("k2", b"\x02")
+
+
+# -- elastic sync retry/skip -----------------------------------------------
+def _elastic_ctl(**kw):
+    from singa_tpu.parallel.elastic import ElasticController
+    cfg = UpdaterConfig(type="kSGD", base_learning_rate=0.1,
+                        param_type="Elastic", moving_rate=0.5,
+                        sync_frequency=1, warmup_steps=0)
+    return ElasticController(cfg, log_fn=lambda s: None,
+                             sync_backoff=_NO_WAIT, **kw)
+
+
+def test_elastic_sync_retries_transient_failure():
+    import jax.numpy as jnp
+    ctl = _elastic_ctl()
+    params = {"w": jnp.full((4,), 2.0)}
+    params = ctl.maybe_sync(0, params)          # lazy center init
+    # visit 0 fails, the in-round retry (visit 1) succeeds
+    with inject(FaultSchedule([FaultSpec("sync.elastic", 0, "error")])):
+        out = ctl.maybe_sync(1, params)
+    assert ctl.skipped_rounds == 0
+    # the exchange actually happened: replica moved toward the center
+    np.testing.assert_allclose(np.asarray(out["w"]), 2.0)
+    assert ctl.center is not None
+
+
+def test_elastic_sync_skips_round_after_budget():
+    import jax.numpy as jnp
+    ctl = _elastic_ctl(sync_retries=2)
+    params = {"w": jnp.full((4,), 2.0)}
+    params = ctl.maybe_sync(0, params)
+    center_before = np.asarray(ctl.center["w"]).copy()
+    with inject(FaultSchedule(rates={"sync.elastic": 1.0}, seed=0)):
+        out = ctl.maybe_sync(1, {"w": jnp.full((4,), 5.0)})
+    assert ctl.skipped_rounds == 1
+    # degraded, not dead: params and center both unchanged
+    np.testing.assert_allclose(np.asarray(out["w"]), 5.0)
+    np.testing.assert_allclose(np.asarray(ctl.center["w"]), center_before)
+
+
+def test_trainer_restores_signal_handlers_after_mid_loop_failure(
+        tmp_path):
+    """An exception escaping the run loop must not leave the trainer's
+    SIGTERM/SIGINT hooks installed (the Supervisor would miss real
+    preemption signals on the next attempt)."""
+    import signal
+    tr = Trainer(_mlp_cfg(train_steps=6, ckpt_freq=2), SHAPES,
+                 log_fn=lambda s: None, donate=False)
+    p, o = tr.init(seed=0)
+    before = signal.getsignal(signal.SIGTERM)
+    with inject(FaultSchedule([FaultSpec("step.train", 1, "error")])):
+        with pytest.raises(FaultError):
+            tr.run(p, o, _data_factory(), seed=0,
+                   workspace=str(tmp_path))
+    assert signal.getsignal(signal.SIGTERM) is before
