@@ -292,6 +292,71 @@ def bench_decode(batch_size=8, prompt_len=128, new_tokens=256,
             "precision": precision}
 
 
+def bench_feed_smoke(batch_size=64, steps=60, scan_chunk=10,
+                     out=None):
+    """Feed-pipeline A/B (ISSUE 2 acceptance): the LeNet train loop
+    through Trainer.run with the DeviceFeeder ON vs OFF at the same
+    scan_chunk, pulling the synthetic source DIRECTLY (no Prefetcher:
+    that is a separate batch-granular stage — this smoke isolates the
+    feed stage, so the off leg pays generation + stacking inline
+    exactly where a prefetch-less loop would).  Reports steps/sec and
+    the HOST-WAIT FRACTION of loop wall time: (wait + inline stage)
+    for the synchronous leg vs consumer-side wait alone for the
+    overlapped leg, whose staging runs on the producer thread.  `out`
+    writes the JSON line to a file as well (scripts/perf_smoke.sh ->
+    BENCH_pr2.json).
+
+    batch 64 (not the throughput-optimal 512): this container's CPU is
+    a single core, so the A/B must keep the compute share small enough
+    that the data path is measurable at all; the fraction, not the
+    absolute throughput, is the recorded metric."""
+    import jax
+
+    from singa_tpu.data.synthetic import synthetic_image_batches
+
+    trainer, _, _, _ = _lenet_trainer(batch_size)
+    trainer.cfg.train_steps = steps
+    trainer.cfg.display_frequency = 0
+    trainer.cfg.test_frequency = 0
+
+    def one(feeder):
+        params, opt_state = trainer.init(seed=0)
+        it = synthetic_image_batches(batch_size, seed=1, stream_seed=7)
+        trainer.timer.reset()
+        t0 = time.perf_counter()
+        trainer.run(params, opt_state, it, seed=0,
+                    scan_chunk=scan_chunk, feeder=feeder)
+        wall = time.perf_counter() - t0
+        tm = dict(trainer.timer.times)
+        host_wait = tm.get("wait", 0.0) + (0.0 if feeder
+                                           else tm.get("stage", 0.0))
+        return {"wall_s": round(wall, 4),
+                "steps_per_sec": round(steps / wall, 2),
+                "img_per_sec": round(steps * batch_size / wall, 1),
+                "wait_s": round(tm.get("wait", 0.0), 4),
+                "stage_s": round(tm.get("stage", 0.0), 4),
+                "train_s": round(tm.get("train", 0.0), 4),
+                "host_wait_fraction": round(host_wait / wall, 4)}
+
+    one(False)   # warm the compile caches so both A/B legs are steady
+    off, on = one(False), one(True)
+    result = {
+        "metric": "lenet_feed_pipeline",
+        "value": round(off["host_wait_fraction"]
+                       - on["host_wait_fraction"], 4),
+        "unit": "host_wait_fraction_drop",
+        "feeder_on": on, "feeder_off": off,
+        "batch": batch_size, "steps": steps, "scan_chunk": scan_chunk,
+        "backend": jax.default_backend(),
+        "cpu_count": os.cpu_count(),
+    }
+    line = json.dumps(result)
+    if out:
+        with open(out, "w") as f:
+            f.write(line + "\n")
+    return result
+
+
 def _convergence_aux():
     path = os.path.join(REPO, "CONVERGENCE.json")
     if not os.path.exists(path):
@@ -312,6 +377,12 @@ def _convergence_aux():
 def main() -> None:
     if "--cpu-baseline" in sys.argv:
         bench_cpu_baseline()
+        return
+    if "--feed-smoke" in sys.argv:
+        out = None
+        if "--out" in sys.argv:
+            out = sys.argv[sys.argv.index("--out") + 1]
+        print(json.dumps(bench_feed_smoke(out=out)))
         return
     # transformer FIRST: round 3 recorded it at 0.4996 because it ran
     # after the full AlexNet bench on a session-warmed chip; the

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Feed-pipeline perf smoke (ISSUE 2 satellite): run the LeNet bench
+# loop with the DeviceFeeder ON vs OFF at the same scan_chunk and
+# record steps/sec plus the host data-wait fraction of step time in
+# BENCH_pr2.json — the first point of the bench trajectory for the
+# overlapped feed path.  The acceptance property is a measurable
+# host-wait-fraction drop with the feeder enabled (the `value` field).
+#
+# Usage: scripts/perf_smoke.sh [out.json]     (CPU-only, no data)
+# CI: pytest -m perf runs the same leg via tests/test_perf_smoke.py.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT="${1:-BENCH_pr2.json}"
+export JAX_PLATFORMS=cpu
+
+python bench.py --feed-smoke --out "$OUT"
+
+python - "$OUT" <<'EOF'
+import json, sys
+with open(sys.argv[1]) as f:
+    r = json.load(f)
+on, off = r["feeder_on"], r["feeder_off"]
+print(f"feeder off: {off['steps_per_sec']} steps/s, "
+      f"host-wait {off['host_wait_fraction']:.1%}")
+print(f"feeder on : {on['steps_per_sec']} steps/s, "
+      f"host-wait {on['host_wait_fraction']:.1%}")
+assert r["value"] > 0, (
+    f"host-wait fraction did not drop with the feeder enabled: "
+    f"off={off['host_wait_fraction']} on={on['host_wait_fraction']}")
+print(f"PERF SMOKE PASS: host-wait fraction dropped by {r['value']:.1%}")
+EOF

@@ -158,11 +158,19 @@ class Supervisor:
             val_iter_factory: Optional[Callable[[], Iterator]] = None,
             seed: int = 0, scan_chunk: int = 0,
             hooks: Optional[List[Callable[[int, Dict], None]]] = None,
-            resume: bool = False):
+            resume: bool = False, feeder: Optional[bool] = None,
+            feeder_depth: int = 0):
         """Run to train_steps under supervision.  Returns the trainer's
         (params, opt_state, history) — history covers the final
         (successful) attempt.  Raises TrainingAborted when the error
-        budget is spent."""
+        budget is spent.
+
+        `feeder`/`feeder_depth` pass through to Trainer.run's overlapped
+        feed pipeline; recovery is feeder-transparent — each attempt
+        rebuilds the fast-forwarded iterator and a FRESH DeviceFeeder
+        whose chunk plan starts at the restored step, and failures on
+        the staging thread (the `feed.stage` site) surface on the
+        consumer side like any step failure."""
         errors = preemptions = 0
         attempt = 0
         last_seen = [-1]
@@ -191,7 +199,8 @@ class Supervisor:
                     test_iter_factory=test_iter_factory,
                     val_iter_factory=val_iter_factory,
                     start_step=start_step, seed=seed, hooks=probes,
-                    workspace=self.workspace, scan_chunk=scan_chunk)
+                    workspace=self.workspace, scan_chunk=scan_chunk,
+                    feeder=feeder, feeder_depth=feeder_depth)
             except Preemption as e:
                 preemptions += 1
                 self._record(attempt, "preemption", e, last_seen[0])

@@ -58,11 +58,16 @@ class Performance:
 
 @dataclass
 class TimerInfo:
-    """Per-phase wall-time accumulator (worker.h:91-114).  The host
-    phases (data wait / device step) are timed directly; the device-side
-    fwd/bwd/update split the reference timed around each phase call is
-    one fused XLA program here, so it comes from a one-shot profiler
-    trace (Trainer.profile_phases) and rides along as `phase_shares`."""
+    """Per-phase wall-time accumulator (worker.h:91-114).  Host phases:
+    `wait` (blocked on the batch source / DeviceFeeder), `stage` (stack
+    + device_put — ON the critical path in the synchronous loop, a
+    producer-thread measurement that OVERLAPS `train` when the feeder
+    is active, so wait+stage+train can exceed wall time there — see
+    docs/PERFORMANCE.md), `train` (dispatch + device sync).  The
+    device-side fwd/bwd/update split the reference timed around each
+    phase call is one fused XLA program here, so it comes from a
+    one-shot profiler trace (Trainer.profile_phases) and rides along as
+    `phase_shares`."""
     times: Dict[str, float] = field(default_factory=dict)
     steps: int = 0
     phase_shares: Optional[Dict[str, float]] = None
@@ -126,6 +131,12 @@ class Trainer:
         self.train_net = build_net(model_cfg, "kTrain", input_shapes)
         self.test_net = self._maybe_net("kTest", input_shapes)
         self.val_net = self._maybe_net("kValidation", input_shapes)
+        # sequence-parallel nets shard token dims over "seq" too —
+        # input placement (_batch_place/_chunk_place) must match
+        self._uses_sp = any(
+            l.attention_param and l.attention_param.seq_parallel != "none"
+            for l in (model_cfg.neuralnet.layer
+                      if model_cfg.neuralnet else []))
         self.updater = make_updater(model_cfg.updater)
         self.multipliers = self.train_net.multipliers()
         self._pipeline_nets = self._maybe_pipeline(n_micro)
@@ -443,6 +454,68 @@ class Trainer:
         opt_state = self.updater.init(params)
         return params, opt_state
 
+    # -- input placement + feed pipeline knobs -----------------------------
+    def _batch_place(self, batch):
+        """Sharded device placement for ONE batch (batch dim 0): under
+        a mesh the batch dim shards over "data" (token dims over "seq"
+        for sequence-parallel nets); without a mesh the batch is left
+        to the jitted step's own placement."""
+        if self.mesh is None:
+            return batch
+        from ..parallel import (batch_shardings, seq_batch_shardings,
+                                shard_batch)
+        return shard_batch(self.mesh, batch,
+                           shardings_fn=(seq_batch_shardings
+                                         if self._uses_sp
+                                         else batch_shardings))
+
+    def _chunk_place(self, stacked):
+        """Placement for a STACKED chunk (leading scan axis, batch at
+        dim 1): sharded device_put under the mesh — the fix for
+        jnp.stack landing chunks on the default device — or a plain
+        async device_put without one (either way the transfer can
+        overlap the previous chunk's scan)."""
+        if self.mesh is None:
+            return jax.device_put(stacked)
+        from ..parallel import place_chunk
+        return place_chunk(self.mesh, stacked,
+                           seq_axis=("seq" if self._uses_sp else None))
+
+    @staticmethod
+    def _feeder_on(feeder: Optional[bool]) -> bool:
+        """Overlapped feed is ON by default for chunked loops; an
+        explicit argument wins, then SINGA_TPU_FEEDER=0/1."""
+        if feeder is not None:
+            return bool(feeder)
+        return os.environ.get("SINGA_TPU_FEEDER", "1") != "0"
+
+    @staticmethod
+    def _feeder_depth(depth: int = 0) -> int:
+        """Staged-chunks-ahead bound (argument, then
+        SINGA_TPU_FEEDER_DEPTH, default 2 — docs/PERFORMANCE.md)."""
+        if depth and depth > 0:
+            return int(depth)
+        try:
+            return max(1, int(os.environ.get("SINGA_TPU_FEEDER_DEPTH",
+                                             "2")))
+        except ValueError:
+            return 2
+
+    def _chunk_plan(self, start_step: int, scan_chunk: int):
+        """Deterministic (start, length) chunk descriptors covering
+        [start_step, train_steps) with the SAME cadence cuts the run
+        loop computes — so the DeviceFeeder stages ahead without ever
+        pulling a batch the loop won't train on, and a Supervisor
+        restart (new start_step, fast-forwarded iterator) replays the
+        identical consumption.  Pure in step (cadence config +
+        elastic.sync_now are stateless predicates), so producer-thread
+        evaluation is safe."""
+        step = start_step
+        while step < self.cfg.train_steps:
+            n = self._next_chunk_len(step, scan_chunk)
+            yield step, n
+            step += n
+
     # -- cadence helpers (worker.h:127-160 semantics) ----------------------
     def _now(self, step, freq, after) -> bool:
         return freq > 0 and step >= after and step % freq == 0
@@ -461,29 +534,52 @@ class Trainer:
 
     # -- loops -------------------------------------------------------------
     def evaluate(self, params, data_iter: Iterator, steps: int,
-                 step_fn, scan_chunk: int = 25) -> Dict[str, float]:
+                 step_fn, scan_chunk: int = 25,
+                 feeder: Optional[bool] = None) -> Dict[str, float]:
         """Average metrics over `steps` eval batches.  When `step_fn` is
         one of the trainer's own eval steps, full chunks of `scan_chunk`
         batches run as ONE fused lax.scan dispatch (same amortization as
-        the train loop's scan_chunk); the remainder and custom step_fns
-        dispatch per batch."""
+        the train loop's scan_chunk), consuming pre-staged chunks from a
+        DeviceFeeder (staging overlaps the previous chunk's eval scan);
+        the remainder and custom step_fns dispatch per batch.  Chunks
+        and single batches both land sharded under the trainer's mesh.
+        `feeder=False` (or SINGA_TPU_FEEDER=0) stages inline instead."""
         perf = Performance()
         steps = max(steps, 1)
         scan_fn = getattr(self, "_eval_scans", {}).get(id(step_fn))
         done = 0
         chunk = min(steps, max(scan_chunk, 1))
         if scan_fn is not None and chunk > 1:
-            while steps - done >= chunk:
-                batches = [next(data_iter) for _ in range(chunk)]
-                stacked = jax.tree_util.tree_map(
-                    lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
-                    *batches)
-                ms = jax.device_get(scan_fn(params, stacked))
+            def eat(ms):
                 for i in range(chunk):
                     perf.update({k: v[i] for k, v in ms.items()})
-                done += chunk
+            nchunks = steps // chunk
+            if self._feeder_on(feeder) and nchunks > 0:
+                from ..data.feed import DeviceFeeder
+                fd = DeviceFeeder(
+                    data_iter, ((i * chunk, chunk)
+                                for i in range(nchunks)),
+                    place=self._chunk_place,
+                    depth=self._feeder_depth(), capacity=chunk)
+                try:
+                    for _ in range(nchunks):
+                        eat(jax.device_get(
+                            scan_fn(params, fd.get().batches)))
+                finally:
+                    # stops the staging thread only — the remainder
+                    # below keeps reading the same (untouched) iterator
+                    fd.close()
+                done = nchunks * chunk
+            else:
+                from ..data.feed import ChunkStager
+                stager = ChunkStager(self._chunk_place, capacity=chunk)
+                while steps - done >= chunk:
+                    batches = [next(data_iter) for _ in range(chunk)]
+                    eat(jax.device_get(
+                        scan_fn(params, stager.stage(batches))))
+                    done += chunk
         for _ in range(steps - done):
-            batch = next(data_iter)
+            batch = self._batch_place(next(data_iter))
             perf.update(jax.device_get(step_fn(params, batch)))
         return perf.averages()
 
@@ -534,18 +630,28 @@ class Trainer:
             val_iter_factory: Optional[Callable[[], Iterator]] = None,
             start_step: int = 0, seed: int = 0,
             hooks: Optional[List[Callable[[int, Dict], None]]] = None,
-            workspace: Optional[str] = None, scan_chunk: int = 0):
+            workspace: Optional[str] = None, scan_chunk: int = 0,
+            feeder: Optional[bool] = None, feeder_depth: int = 0):
         """The Worker::Run loop (worker.cc:98-106).  With `workspace`,
         checkpoints {params, opt_state, step} at checkpoint_frequency and
         on completion (the resume path the reference left as a TODO,
         worker.cc:65-67).
 
         `scan_chunk > 1` runs up to that many steps per device dispatch
-        via the fused lax.scan program (train_steps): batches are
-        prefetched and stacked on the host, the device runs the whole
-        chunk without host round-trips, and cadence events (test/
-        validate/checkpoint/display) still fire at exactly the reference
-        steps because chunks are cut at their boundaries.
+        via the fused lax.scan program (train_steps); cadence events
+        (test/validate/checkpoint/display) still fire at exactly the
+        reference steps because chunks are cut at their boundaries.
+        By default the chunked loop is OVERLAPPED: a DeviceFeeder
+        thread stages chunk k+1 (stack into reusable buffers + sharded
+        device_put) while chunk k's scan runs, and per-chunk metrics
+        stay on device in a small ring, drained only at display/eval/
+        checkpoint boundaries — the host never blocks on data or
+        metrics between chunks (docs/PERFORMANCE.md).  `feeder=False`
+        (or SINGA_TPU_FEEDER=0) selects the synchronous fallback, which
+        stages inline through the SAME sharded placement helper;
+        `feeder_depth` (or SINGA_TPU_FEEDER_DEPTH) bounds how many
+        chunks the feeder runs ahead.  Both paths produce bit-identical
+        trajectories (tests/test_feed.py).
 
         Preemption safety (the failure-recovery story the reference
         lacks, SURVEY.md §5 — any process death hangs its job): while a
@@ -571,53 +677,42 @@ class Trainer:
                      f"{self.cfg.updater.warmup_steps}")
         history: List[Dict[str, float]] = []
         step = start_step
-        try:
-            while step < self.cfg.train_steps:
-                faults.maybe_fault("step.train")
-                if interrupted:
-                    self.log(f"signal {interrupted[0]} received: checkpointing "
-                             f"at step {step} and stopping")
-                    ckpt.save(step, *self._ckpt_state(params, opt_state))
-                    break
-                if self.val_step and self.validate_now(step) and val_iter_factory:
-                    avg = self.evaluate(params, val_iter_factory(),
-                                        self.cfg.validation_steps, self.val_step)
-                    self.log(f"step-{step} validation: " + ", ".join(
-                        f"{k} : {v:.6f}" for k, v in sorted(avg.items())))
-                if self.test_step and self.test_now(step) and test_iter_factory:
-                    avg = self.evaluate(params, test_iter_factory(),
-                                        self.cfg.test_steps, self.test_step)
-                    self.log(f"step-{step} test: " + ", ".join(
-                        f"{k} : {v:.6f}" for k, v in sorted(avg.items())))
-                    history.append({"step": step, **avg})
+        chunked = bool(scan_chunk and scan_chunk > 1)
+        fd = stager = None
+        if chunked and self._feeder_on(feeder):
+            from ..data.feed import DeviceFeeder
+            fd = DeviceFeeder(train_iter,
+                              self._chunk_plan(start_step, scan_chunk),
+                              place=self._chunk_place,
+                              depth=self._feeder_depth(feeder_depth),
+                              capacity=scan_chunk)
+        elif chunked:
+            from ..data.feed import ChunkStager
+            stager = ChunkStager(self._chunk_place, capacity=scan_chunk)
 
-                n = (self._next_chunk_len(step, scan_chunk)
-                     if scan_chunk and scan_chunk > 1 else 1)
-                t0 = time.perf_counter()
-                if n == 1:
-                    batch = next(train_iter)
-                    t1 = time.perf_counter()
-                    params, opt_state, metrics = self.train_step(
-                        params, opt_state, batch, step,
-                        jax.random.fold_in(rng, step))
-                    per_step = [jax.device_get(metrics)]
-                else:
-                    batches = [next(train_iter) for _ in range(n)]
-                    stacked = jax.tree_util.tree_map(
-                        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
-                        *batches)
-                    t1 = time.perf_counter()
-                    params, opt_state, metrics = self.train_steps(
-                        params, opt_state, stacked, step, rng, n, True)
-                    md = jax.device_get(metrics)
-                    per_step = [{k: v[i] for k, v in md.items()}
-                                for i in range(n)]
-                t2 = time.perf_counter()
-                self.timer.add("data", t1 - t0)
-                self.timer.add("train", t2 - t1)
-                self.timer.steps += n
+        # Deferred metric drain: per-chunk metrics stay device-resident
+        # in `pending` and are fetched in order only at boundaries.
+        # With the feeder the ring holds depth+1 chunks — the drain's
+        # device_get doubles as backpressure, bounding in-flight
+        # dispatches (and their staged input buffers) instead of letting
+        # the host race arbitrarily far ahead.  Without it the ring is 1
+        # (the synchronous per-chunk fetch, exactly the old loop).
+        ring = (self._feeder_depth(feeder_depth) + 1
+                if fd is not None else 1)
+        pending: List[tuple] = []
+        staged_credit = [0.0]   # feeder stage_seconds already reported
+        last_dbg = [None]       # newest single-batch view (debug/profile)
+
+        def _drain():
+            while pending:
+                s0, n, md, stacked = pending.pop(0)
+                tg = time.perf_counter()
+                md = jax.device_get(md)   # device sync: train time
+                self.timer.add("train", time.perf_counter() - tg)
+                per_step = ([{k: v[i] for k, v in md.items()}
+                             for i in range(n)] if stacked else [md])
                 for i, m in enumerate(per_step):
-                    s = step + i
+                    s = s0 + i
                     self.perf.update(m)
                     if hooks:
                         for h in hooks:
@@ -631,24 +726,101 @@ class Trainer:
                             # never let a profiler hiccup kill training
                             try:
                                 self.profile_phases(
-                                    params, opt_state,
-                                    batch if n == 1 else batches[-1],
-                                    step=step, rng=rng)
+                                    params, opt_state, last_dbg[0],
+                                    step=s, rng=rng)
                             except Exception as e:  # pragma: no cover
                                 self.timer.phase_shares = {}
-                                self.log(f"warning: phase profile failed: "
-                                         f"{e}")
+                                self.log(f"warning: phase profile "
+                                         f"failed: {e}")
                         self.log(f"step-{s}: {self.perf.to_string()}")
                         self.log(self.timer.to_string())
                         self.perf.reset()
+
+        try:
+            while step < self.cfg.train_steps:
+                faults.maybe_fault("step.train")
+                if interrupted:
+                    _drain()   # hooks/logs for every trained step first
+                    self.log(f"signal {interrupted[0]} received: checkpointing "
+                             f"at step {step} and stopping")
+                    ckpt.save(step, *self._ckpt_state(params, opt_state))
+                    break
+                if self.val_step and self.validate_now(step) and val_iter_factory:
+                    _drain()
+                    avg = self.evaluate(params, val_iter_factory(),
+                                        self.cfg.validation_steps, self.val_step)
+                    self.log(f"step-{step} validation: " + ", ".join(
+                        f"{k} : {v:.6f}" for k, v in sorted(avg.items())))
+                if self.test_step and self.test_now(step) and test_iter_factory:
+                    _drain()
+                    avg = self.evaluate(params, test_iter_factory(),
+                                        self.cfg.test_steps, self.test_step)
+                    self.log(f"step-{step} test: " + ", ".join(
+                        f"{k} : {v:.6f}" for k, v in sorted(avg.items())))
+                    history.append({"step": step, **avg})
+
+                n = self._next_chunk_len(step, scan_chunk) if chunked else 1
+                t0 = time.perf_counter()
+                if not chunked:
+                    batch = next(train_iter)
+                    t1 = time.perf_counter()
+                    batch = self._batch_place(batch)
+                    t2 = time.perf_counter()
+                    params, opt_state, metrics = self.train_step(
+                        params, opt_state, batch, step,
+                        jax.random.fold_in(rng, step))
+                    t3 = time.perf_counter()
+                    pending.append((step, 1, metrics, False))
+                    last_dbg[0] = batch
+                elif fd is not None:
+                    chunk = fd.get()   # blocks only if staging is behind
+                    t1 = time.perf_counter()
+                    if chunk.start != step or chunk.length != n:
+                        from ..data.feed import FeedError
+                        raise FeedError(
+                            f"feed plan diverged: staged chunk "
+                            f"[{chunk.start}, +{chunk.length}) vs loop "
+                            f"[{step}, +{n})")
+                    t2 = t1
+                    params, opt_state, metrics = self.train_steps(
+                        params, opt_state, chunk.batches, step, rng, n,
+                        True)
+                    t3 = time.perf_counter()
+                    pending.append((step, n, metrics, True))
+                    last_dbg[0] = jax.tree_util.tree_map(
+                        lambda x: x[n - 1], chunk.batches)
+                    # producer-side staging time since the last sample —
+                    # real host work, but OFF the critical path
+                    self.timer.add("stage",
+                                   fd.stage_seconds - staged_credit[0])
+                    staged_credit[0] = fd.stage_seconds
+                else:
+                    batches = [next(train_iter) for _ in range(n)]
+                    t1 = time.perf_counter()
+                    stacked = stager.stage(batches)
+                    t2 = time.perf_counter()
+                    params, opt_state, metrics = self.train_steps(
+                        params, opt_state, stacked, step, rng, n, True)
+                    t3 = time.perf_counter()
+                    pending.append((step, n, metrics, True))
+                    last_dbg[0] = jax.tree_util.tree_map(
+                        lambda x: x[n - 1], stacked)
+                self.timer.add("wait", t1 - t0)
+                if t2 > t1:
+                    self.timer.add("stage", t2 - t1)
+                self.timer.add("train", t3 - t2)
+                self.timer.steps += n
+                if (len(pending) >= ring
+                        or any(self.display_now(step + i)
+                               for i in range(n))):
+                    _drain()
                 if (self.debug_step is not None
                         and any(self.display_now(step + i) for i in range(n))):
                     # debug norms reflect the post-chunk params, so label
                     # them with the chunk's last step, not a mid-chunk one
                     s_dbg = step + n - 1
-                    dbg_batch = batch if n == 1 else batches[-1]
                     outs, grads = self.debug_step(
-                        params, dbg_batch, s_dbg,
+                        params, last_dbg[0], s_dbg,
                         jax.random.fold_in(rng, s_dbg))
                     self.log(f"step-{s_dbg} debug:\n" +
                              self.train_net.debug_info(params, outs, grads))
@@ -661,12 +833,19 @@ class Trainer:
                 if (ckpt is not None and self.cfg.checkpoint_frequency > 0
                         and last >= self.cfg.checkpoint_after_steps
                         and (last + 1) % self.cfg.checkpoint_frequency == 0):
+                    # drain BEFORE the save: every hook/metric below the
+                    # snapshot step has fired, so a crash-and-restore
+                    # never leaves a hook gap behind the resume point
+                    _drain()
                     ckpt.save(last + 1, *self._ckpt_state(params, opt_state))
                 step += n
+            _drain()
         finally:
-            # an exception mid-loop (injected fault, data
-            # failure) must not leave our signal handlers
-            # installed in the supervisor's process
+            # an exception mid-loop (injected fault, data failure) must
+            # not leave our signal handlers installed in the
+            # supervisor's process, nor the feed thread running
+            if fd is not None:
+                fd.close()
             self._ckpt_unguard(old_handlers)
         if (ckpt is not None and not interrupted
                 and self.cfg.train_steps > start_step):

@@ -89,6 +89,20 @@ class Updater:
     def __init__(self, cfg: UpdaterConfig):
         self.cfg = cfg
         self.type = cfg.type
+        # default-Multipliers pytrees, keyed by param treedef: built
+        # ONCE (at init / first update) instead of on every traced
+        # update call — the update runs inside the scan body, so every
+        # per-call tree rebuild was paid per trace and inflated the
+        # jaxpr's construction cost
+        self._default_mults: Dict[Any, Any] = {}
+
+    def _default_multipliers(self, treedef):
+        tree = self._default_mults.get(treedef)
+        if tree is None:
+            tree = jax.tree_util.tree_unflatten(
+                treedef, [Multipliers()] * treedef.num_leaves)
+            self._default_mults[treedef] = tree
+        return tree
 
     # -- state ------------------------------------------------------------
     def init(self, params) -> Dict[str, Any]:
@@ -96,28 +110,27 @@ class Updater:
         state: Dict[str, Any] = {"history": zeros}
         if self.type in ("kAdaDelta", "kAdam"):
             state["update"] = jax.tree_util.tree_map(jnp.zeros_like, params)
+        # hoist: pre-build the default multiplier tree for this param
+        # structure so no update call ever constructs it
+        self._default_multipliers(jax.tree_util.tree_structure(params))
         return state
 
     # -- update -----------------------------------------------------------
     def update(self, step, grads, params, state,
                multipliers=None, grad_scale: float = 1.0):
         cfg = self.cfg
+        # one flatten pass yields leaves AND treedef; the other trees
+        # reuse the treedef (flatten_up_to) instead of re-deriving it
+        p_l, treedef = jax.tree_util.tree_flatten(params)
         if multipliers is None:
-            multipliers = jax.tree_util.tree_map(
-                lambda _: Multipliers(), params,
-                is_leaf=lambda x: isinstance(x, jnp.ndarray))
+            multipliers = self._default_multipliers(treedef)
         lr = learning_rate(cfg, step) if cfg.base_learning_rate else 0.0
 
-        def leaves(*trees):
-            return [jax.tree_util.tree_leaves(
-                t, is_leaf=lambda x: isinstance(x, Multipliers))
-                for t in trees]
-
-        treedef = jax.tree_util.tree_structure(params)
-
-        p_l, g_l, m_l = leaves(params, grads, multipliers)
-        h_l = jax.tree_util.tree_leaves(state["history"])
-        u_l = (jax.tree_util.tree_leaves(state["update"])
+        g_l = treedef.flatten_up_to(grads)
+        m_l = jax.tree_util.tree_leaves(
+            multipliers, is_leaf=lambda x: isinstance(x, Multipliers))
+        h_l = treedef.flatten_up_to(state["history"])
+        u_l = (treedef.flatten_up_to(state["update"])
                if "update" in state else [None] * len(p_l))
 
         new_p, new_h, new_u = [], [], []

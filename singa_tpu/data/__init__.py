@@ -7,6 +7,7 @@ from typing import Callable, Iterator, Tuple
 from .discovery import discover_input_shapes
 from .records import Datum, Record, SingleLabelImageRecord
 from .shard import Shard, ShardError
+from .feed import ChunkStager, DeviceFeeder, FeedChunk, FeedError
 from .pipeline import (PipelineStats, PrefetchError, Prefetcher, prefetch,
                        shard_batches)
 from .synthetic import synthetic_image_batches

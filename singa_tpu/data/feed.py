@@ -1,0 +1,312 @@
+"""Overlapped host→device feed stage: async chunk staging for the
+fused train loop.
+
+The reference hides data cost by running prefetch threads beside the
+Executor compute (worker.cc:98-106, 163-177); our fused lax.scan train
+loop removed per-step dispatch but left the HOST serial — Trainer.run
+pulled and stacked every chunk on the critical path, then blocked on
+`jax.device_get(metrics)` before touching the next batch.  This module
+is the missing pipeline stage between `Prefetcher` (batch-granular,
+pure host I/O) and the train loop (chunk-granular, device-resident):
+
+    source → Prefetcher → DeviceFeeder → train_steps scan
+             (batches)    (staged, device-placed chunks)
+
+`ChunkStager` stacks a list of host batch trees into REUSABLE numpy
+staging buffers (no per-chunk allocation) and places the stacked chunk
+on device — under a mesh, with the batch-dim `NamedSharding` the
+compiled step expects (parallel.partition.place_chunk), so the input
+lands pre-sharded instead of on the default device.  `DeviceFeeder`
+runs a stager on a background thread over a deterministic chunk *plan*
+(the exact (start_step, length) sequence the train loop will consume,
+cut at the same cadence boundaries), keeping `depth` staged chunks
+ahead: chunk k+1 is already on device while chunk k's scan runs.
+
+Failure contract (mirrors Prefetcher, docs/FAULT_TOLERANCE.md): a
+producer-thread exception re-raises on `get()` — including injected
+faults at the new `feed.stage` site, so the Supervisor's
+restore-and-replay covers the async path; a producer that dies without
+signaling raises `FeedError` instead of hanging; `close()` stops the
+thread WITHOUT closing the upstream iterator (its owner — e.g. the
+Supervisor, which rebuilds and fast-forwards it on restart — manages
+that lifetime).  Determinism: the feeder consumes exactly one batch
+per step in order, so the Supervisor's fast-forward-by-step contract
+is unchanged.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Iterable, Iterator, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ..utils.faults import maybe_fault
+from .pipeline import PrefetchError, ProducerDied, poll_queue
+
+
+class FeedError(PrefetchError):
+    """The feed producer died, stalled, or delivered a chunk that does
+    not match the consumer's plan (distinct from StopIteration = the
+    plan — or the upstream data — ran out cleanly)."""
+
+
+class FeedChunk(NamedTuple):
+    """One staged chunk: `batches` carries a leading `length` step axis
+    on every leaf and is already device-placed (sharded under a mesh)."""
+    start: int
+    length: int
+    batches: Any
+
+
+#: XLA host-buffer zero-copy needs this alignment; staging buffers are
+#: allocated to deliberately MISS it (see staging_buffer).
+_XLA_HOST_ALIGN = 64
+
+
+def staging_buffer(shape: Tuple[int, ...], dtype) -> np.ndarray:
+    """An uninitialized array whose data pointer is itemsize-aligned
+    but deliberately NOT 64-byte aligned.
+
+    Why: XLA's CPU client zero-copy ALIASES a sufficiently aligned host
+    numpy buffer on device_put (verified on this runtime: aliasing iff
+    addr % 64 == 0, and np.empty hits that alignment at the
+    allocator's whim) — so a reused staging buffer would silently
+    corrupt a previously "placed" chunk that an in-flight scan still
+    reads.  A misaligned source forces the copy path on every backend,
+    which is exactly what buffer reuse needs; the byte offset costs
+    nothing measurable on the staging memcpy."""
+    dt = np.dtype(dtype)
+    nbytes = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+    # offset ≡ itemsize (mod 64): aligned for numpy element access,
+    # misaligned for XLA's zero-copy check (itemsize < 64 always here)
+    want = dt.itemsize % _XLA_HOST_ALIGN or _XLA_HOST_ALIGN
+    raw = np.empty(nbytes + 2 * _XLA_HOST_ALIGN, np.uint8)
+    off = (want - raw.ctypes.data) % _XLA_HOST_ALIGN
+    buf = raw[off:off + nbytes].view(dt).reshape(shape)
+    assert buf.ctypes.data % _XLA_HOST_ALIGN != 0 or nbytes == 0
+    return buf
+
+
+class ChunkStager:
+    """Stacks host batches into reusable staging buffers and places the
+    chunk on device.
+
+    `place(stacked_tree)` does the device placement — pass the
+    trainer's sharded helper so batches land with the batch-dim
+    NamedSharding; defaults to a plain `jax.device_put`.  `capacity`
+    pre-sizes the leading axis (the loop's scan_chunk); shorter chunks
+    reuse a view of the same buffers, so steady state allocates
+    nothing per chunk.
+
+    Buffer-reuse safety, in two layers.  (1) Buffers come from
+    `staging_buffer` (deliberately misaligned, so no zero-copy path can
+    alias them — the placed chunk is always a COPY).  (2) A buffer set
+    is only overwritten after the transfer staged FROM IT has
+    completed.  With `rotate=1` (the synchronous loop) that means
+    blocking right after `place` — the stream is idle there, so the
+    block is just the transfer.  The DeviceFeeder passes `rotate =
+    depth + 2` buffer sets instead: the chunk is handed over
+    IMMEDIATELY after `place`, and the block moves to the next visit of
+    the same set, a full rotation later — by which point the consumer
+    has long dispatched (and the drain ring synced past) that chunk.
+    Without the rotation, a single-stream runtime (CPU PJRT enqueues
+    host-to-device copies behind queued computations) would stall the
+    producer a whole chunk-compute per stage.
+    """
+
+    def __init__(self, place: Optional[Callable[[Any], Any]] = None,
+                 capacity: int = 0, rotate: int = 1):
+        self._place = place
+        self._capacity = max(int(capacity), 0)
+        self._rotate = max(int(rotate), 1)
+        self._sets: List[Optional[List[np.ndarray]]] = \
+            [None] * self._rotate
+        self._inflight: List[Any] = [None] * self._rotate
+        self._turn = 0
+        self._treedef = None
+
+    def _alloc(self, rows: List[Any], n: int) -> List[np.ndarray]:
+        import jax
+        cap = max(self._capacity, n)
+        return [
+            staging_buffer((cap,) + np.shape(leaf),
+                           # canonicalize like jnp.asarray so the staged
+                           # chunk is bit-identical to the old jnp.stack
+                           # path (float64 host leaves become float32
+                           # under the default x64-disabled config)
+                           jax.dtypes.canonicalize_dtype(
+                               np.asarray(leaf).dtype))
+            for leaf in rows]
+
+    def stage(self, batches: List[Any]) -> Any:
+        """Stack `batches` (a list of pytrees with identical structure)
+        along a new leading axis and place the result on device."""
+        import jax
+
+        fault = maybe_fault("feed.stage")
+        if fault == "torn":
+            # torn has no meaning for an in-memory stage (nothing is
+            # half-written anywhere durable); treat as a no-op
+            fault = None
+        n = len(batches)
+        if n == 0:
+            raise ValueError("cannot stage an empty chunk")
+        flat0, treedef = jax.tree_util.tree_flatten(batches[0])
+        rows = [flat0] + [treedef.flatten_up_to(b) for b in batches[1:]]
+        if self._treedef is None or treedef != self._treedef:
+            self._treedef = treedef
+            self._sets = [None] * self._rotate
+            self._inflight = [None] * self._rotate
+        i = self._turn
+        self._turn = (i + 1) % self._rotate
+        bufs = self._sets[i]
+        if (bufs is None or n > bufs[0].shape[0]
+                or any(b.shape[1:] != np.shape(l)
+                       for b, l in zip(bufs, flat0))):
+            bufs = self._sets[i] = self._alloc(flat0, n)
+            self._inflight[i] = None
+        if self._inflight[i] is not None:
+            # the transfer staged from this set a rotation ago must be
+            # done before its buffers are overwritten
+            jax.block_until_ready(self._inflight[i])
+            self._inflight[i] = None
+        for j, buf in enumerate(bufs):
+            for k, row in enumerate(rows):
+                # same-kind cast copy into the staging row (device_get
+                # happens here implicitly if a caller hands us device
+                # arrays — supported, just not the fast path)
+                np.copyto(buf[k], np.asarray(row[j]))
+        stacked = jax.tree_util.tree_unflatten(
+            treedef, [buf[:n] for buf in bufs])
+        placed = (self._place(stacked) if self._place is not None
+                  else jax.device_put(stacked))
+        if self._rotate == 1:
+            # synchronous caller: safe (and cheap — idle stream) to
+            # wait for the transfer here
+            jax.block_until_ready(placed)
+        else:
+            self._inflight[i] = placed
+        return placed
+
+
+class DeviceFeeder:
+    """Background staging thread: stages chunks of an iterator per a
+    deterministic `plan` and hands them over a bounded queue.
+
+    `plan` is an iterable of (start_step, length) descriptors — the
+    SAME sequence the consumer computes (Trainer._chunk_plan), so the
+    feeder's pre-pulls line up exactly with the loop's cadence cuts and
+    with the Supervisor's one-batch-per-step fast-forward.  `get()`
+    blocks for the next chunk with producer-liveness polling; after the
+    plan is exhausted it raises StopIteration.
+
+    `pull_seconds` / `stage_seconds` accumulate producer-thread time
+    split between waiting on the upstream iterator and stack+device_put
+    work — the trainer samples `stage_seconds` for the step report's
+    `stage` phase (off the critical path by construction; the consumer
+    only ever blocks in `get`, reported as `wait`).
+    """
+
+    _END = object()
+
+    def __init__(self, it: Iterator, plan: Iterable[Tuple[int, int]],
+                 place: Optional[Callable[[Any], Any]] = None,
+                 depth: int = 2, capacity: int = 0,
+                 poll_timeout: float = 0.5,
+                 stall_timeout: Optional[float] = None):
+        self._it = it
+        self._plan = iter(plan)
+        # depth+2 rotating buffer sets: <= depth chunks queued, one in
+        # the consumer's hands, one being staged — the set revisited
+        # next has always been handed over, so staging never blocks on
+        # a live transfer (see ChunkStager)
+        self._stager = ChunkStager(place, capacity=capacity,
+                                   rotate=max(depth, 1) + 2)
+        self._q: queue.Queue = queue.Queue(maxsize=max(depth, 1))
+        self._err: Optional[BaseException] = None
+        self._done = False
+        self._poll = max(poll_timeout, 0.01)
+        self._stall = stall_timeout
+        self.pull_seconds = 0.0
+        self.stage_seconds = 0.0
+        self.chunks_staged = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    # -- producer ----------------------------------------------------------
+    def _put(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=self._poll)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(self):
+        try:
+            for start, n in self._plan:
+                if self._stop.is_set():
+                    return
+                t0 = time.perf_counter()
+                batches = []
+                for _ in range(n):
+                    batches.append(next(self._it))
+                t1 = time.perf_counter()
+                placed = self._stager.stage(batches)
+                t2 = time.perf_counter()
+                self.pull_seconds += t1 - t0
+                self.stage_seconds += t2 - t1
+                self.chunks_staged += 1
+                if not self._put(FeedChunk(start, n, placed)):
+                    return   # closed: nobody reads, no sentinel needed
+        except BaseException as e:    # re-raised on the consumer thread
+            self._err = e             # (incl. injected feed.stage faults
+        finally:                      # and upstream StopIteration)
+            self._put(self._END)
+
+    # -- consumer ----------------------------------------------------------
+    def get(self) -> FeedChunk:
+        """Next staged chunk; blocks with liveness polling.  Raises the
+        producer's error, StopIteration after a clean end of plan, or
+        FeedError for a dead/stalled producer."""
+        if self._done:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        try:
+            item = poll_queue(self._q, self._thread, self._poll,
+                              self._stall, what="feed")
+        except ProducerDied:
+            self._done = True
+            if self._err is not None:
+                raise self._err
+            raise FeedError("feed producer thread died without "
+                            "signaling end of plan")
+        if item is self._END:
+            self._done = True
+            return self.get()
+        return item
+
+    def close(self) -> None:
+        """Stop the producer and release its thread.  Idempotent; does
+        NOT close the upstream iterator."""
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        t = getattr(self, "_thread", None)
+        if t is not None and t.is_alive():
+            t.join(timeout=2.0)
+
+    def __del__(self):  # pragma: no cover — GC timing
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -36,6 +36,40 @@ class PrefetchError(RuntimeError):
     broken (distinct from StopIteration = clean end of data)."""
 
 
+class ProducerDied(Exception):
+    """Internal signal from `poll_queue`: the producer thread exited
+    without a sentinel reaching the consumer.  Callers translate it
+    into their own terminal error (PrefetchError / FeedError) after
+    checking for a captured producer exception."""
+
+
+def poll_queue(q: queue.Queue, thread: threading.Thread, poll: float,
+               stall: Optional[float], what: str = "prefetch"):
+    """Blocking `q.get` with producer-liveness checks — the shared
+    consumer side of every bounded producer/consumer handoff in the
+    data plane (Prefetcher at batch granularity, data.feed.DeviceFeeder
+    at chunk granularity).  Returns the next item; raises ProducerDied
+    when the producer thread is gone and the queue is empty (with a
+    drain-race re-check, since the sentinel may land between the
+    timeout and the liveness probe), or PrefetchError after `stall`
+    seconds without an item from a live-but-stuck producer."""
+    deadline = (time.monotonic() + stall if stall is not None else None)
+    while True:
+        try:
+            return q.get(timeout=poll)
+        except queue.Empty:
+            if not thread.is_alive():
+                try:
+                    return q.get_nowait()
+                except queue.Empty:
+                    raise ProducerDied
+            if deadline is not None and time.monotonic() > deadline:
+                raise PrefetchError(
+                    f"{what} stalled: no item for {stall:.1f}s "
+                    f"(producer alive but stuck — slow or hung "
+                    f"source)")
+
+
 @dataclass
 class PipelineStats:
     """Shared counters between a batch source, its Prefetcher, and the
@@ -305,31 +339,16 @@ class Prefetcher:
                 raise self._err
             raise StopIteration
         maybe_fault("data.prefetch")
-        deadline = (time.monotonic() + self._stall
-                    if self._stall is not None else None)
-        while True:
-            try:
-                item = self._q.get(timeout=self._poll)
-                break
-            except queue.Empty:
-                if not self._thread.is_alive():
-                    # drain race: the sentinel may have landed between
-                    # the timeout and the liveness check
-                    try:
-                        item = self._q.get_nowait()
-                        break
-                    except queue.Empty:
-                        self._done = True
-                        if self._err is not None:
-                            raise self._err
-                        raise PrefetchError(
-                            "prefetch producer thread died without "
-                            "signaling end of data")
-                if deadline is not None and time.monotonic() > deadline:
-                    raise PrefetchError(
-                        f"prefetch stalled: no batch for "
-                        f"{self._stall:.1f}s (producer alive but "
-                        f"stuck — slow or hung data source)")
+        try:
+            item = poll_queue(self._q, self._thread, self._poll,
+                              self._stall, what="prefetch")
+        except ProducerDied:
+            self._done = True
+            if self._err is not None:
+                raise self._err
+            raise PrefetchError(
+                "prefetch producer thread died without "
+                "signaling end of data")
         if item is self._END:
             self._done = True
             return self.__next__()

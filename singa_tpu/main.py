@@ -58,6 +58,18 @@ def make_argparser() -> argparse.ArgumentParser:
                     help="run up to N steps per device dispatch (fused "
                          "lax.scan inner loop; cadence events still fire "
                          "at their exact steps)")
+    ap.add_argument("--feeder", choices=("auto", "on", "off"),
+                    default="auto",
+                    help="overlapped host/device feed pipeline for the "
+                         "chunked loop: a background thread stages the "
+                         "next chunk (stack + sharded device_put) while "
+                         "the current one trains (auto = on when "
+                         "scan_chunk > 1 unless SINGA_TPU_FEEDER=0; "
+                         "see docs/PERFORMANCE.md)")
+    ap.add_argument("--feeder_depth", "--feeder-depth", type=int,
+                    dest="feeder_depth", default=0,
+                    help="staged chunks the feeder may run ahead "
+                         "(0 = SINGA_TPU_FEEDER_DEPTH or 2)")
     ap.add_argument("--phase_profile", action="store_true",
                     help="measure the device fwd/bwd/update split once "
                          "(profiler trace) and report it at every "
@@ -205,38 +217,32 @@ def _run(args) -> int:
                 f"{k} : {v:.6f}" for k, v in sorted(avg.items())))
         return 0
 
-    if mesh is not None:
-        from .parallel import (batch_shardings, seq_batch_shardings,
-                               shard_batch)
-        uses_sp = any(
-            l.attention_param and l.attention_param.seq_parallel != "none"
-            for l in model.neuralnet.layer)
-        shard_fn = seq_batch_shardings if uses_sp else batch_shardings
-
-        def _sharded(it):
-            for b in it:
-                yield shard_batch(mesh, b, shardings_fn=shard_fn)
-    else:
-        def _sharded(it):
-            return it
-
+    # Batch placement (sharded device_put under the mesh) is the
+    # trainer's job now — _batch_place/_chunk_place inside run() and
+    # evaluate() — so iterators stay HOST-side and the feed pipeline
+    # can stage them into reusable buffers without a device round-trip.
     def make_train_iter():
         it, _ = resolve_data_source(
             model, bs, seed=args.seed, force_synthetic=args.synthetic,
             sample_shapes=input_shapes)
-        return _sharded(it)
+        return it
 
     _, test_factory = resolve_data_source(
         model, bs, seed=args.seed, force_synthetic=args.synthetic,
         sample_shapes=input_shapes)
-    if test_factory is not None:
-        inner_factory = test_factory
-        test_factory = lambda: _sharded(inner_factory())  # noqa: E731
 
     if args.resume and not workspace:
         print("warning: --resume given but no workspace configured "
               "(set --workspace or ClusterProto.workspace); "
               "starting from scratch", file=sys.stderr)
+
+    # auto → None: Trainer.run resolves via SINGA_TPU_FEEDER (default on
+    # for chunked loops)
+    feeder_flag = {"auto": None, "on": True, "off": False}[args.feeder]
+    if args.feeder == "on" and args.scan_chunk <= 1:
+        print("warning: --feeder on has no effect without "
+              "--scan_chunk > 1 (the feeder stages whole scan chunks)",
+              file=sys.stderr)
 
     if args.max_restarts > 0:
         # supervised runtime: restore-the-last-valid-snapshot + replay
@@ -249,7 +255,8 @@ def _run(args) -> int:
             params, opt_state, history = sup.run(
                 make_train_iter, test_iter_factory=test_factory,
                 seed=args.seed, scan_chunk=args.scan_chunk,
-                resume=args.resume)
+                resume=args.resume, feeder=feeder_flag,
+                feeder_depth=args.feeder_depth)
         except TrainingAborted as e:
             print(f"error: {e}", file=sys.stderr)
             return 1
@@ -273,7 +280,8 @@ def _run(args) -> int:
             params, opt_state, make_train_iter(),
             test_iter_factory=test_factory,
             seed=args.seed, start_step=start_step, workspace=workspace,
-            scan_chunk=args.scan_chunk)
+            scan_chunk=args.scan_chunk, feeder=feeder_flag,
+            feeder_depth=args.feeder_depth)
     final = trainer.perf.to_string()
     print("training done" + (f": {final}" if final else
                              f" at step {model.train_steps}"))

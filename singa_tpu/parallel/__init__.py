@@ -7,9 +7,10 @@ only needed when a model actually uses them.
 from .bootstrap import (coordinator_address, distributed_init,
                         parse_hostfile)
 from .mesh import AXES, make_mesh, mesh_from_cluster
-from .partition import (param_shardings, batch_shardings, pad_params,
-                        seq_batch_shardings, shard_params,
-                        shard_opt_state, shard_batch, replicated)
+from .partition import (param_shardings, batch_shardings, chunk_shardings,
+                        pad_params, place_chunk, seq_batch_shardings,
+                        shard_params, shard_opt_state, shard_batch,
+                        replicated)
 
 _LAZY = {
     "ring_attention": ("sequence", "ring_attention"),

@@ -150,3 +150,27 @@ def shard_batch(mesh: Mesh, batch, data_axis: str = "data",
     sequence-parallel token layouts."""
     shardings = (shardings_fn or batch_shardings)(mesh, batch, data_axis)
     return jax.tree_util.tree_map(jax.device_put, batch, shardings)
+
+
+def chunk_shardings(mesh: Mesh, chunk_tree: Any, data_axis: str = "data",
+                    seq_axis: Optional[str] = None) -> Any:
+    """Shardings for a STACKED chunk of batches (leading scan axis):
+    the step axis stays unsharded — every device runs every scan step —
+    while dim 1 (the batch) shards over `data_axis`, exactly the layout
+    `train_steps`' in-scan per-step slices expect.  With `seq_axis`,
+    token leaves of rank >= 3 additionally shard their sequence dim
+    (the stacked form of seq_batch_shardings)."""
+    def leaf(x):
+        if seq_axis is not None and getattr(x, "ndim", 0) >= 3:
+            return NamedSharding(mesh, P(None, data_axis, seq_axis))
+        return NamedSharding(mesh, P(None, data_axis))
+    return jax.tree_util.tree_map(leaf, chunk_tree)
+
+
+def place_chunk(mesh: Mesh, chunk: Any, data_axis: str = "data",
+                seq_axis: Optional[str] = None) -> Any:
+    """device_put a stacked host chunk with batch-dim shardings.  The
+    replacement for `jnp.stack`-ing device batches, which silently
+    gathered the whole chunk onto the default device under a mesh."""
+    shardings = chunk_shardings(mesh, chunk, data_axis, seq_axis)
+    return jax.tree_util.tree_map(jax.device_put, chunk, shardings)

@@ -16,6 +16,9 @@ restart — so a one-shot fault never re-fires during recovery):
 
     data.decode    one record decoded (Prefetcher producer / shard read)
     data.prefetch  one batch handed to the consumer (Prefetcher.__next__)
+    feed.stage     one chunk staged (ChunkStager.stage: stack +
+                   device_put — fires on the DeviceFeeder producer
+                   thread in the overlapped loop, inline otherwise)
     ckpt.save      one checkpoint save (before finalize)
     ckpt.restore   one checkpoint restore attempt
     sync.elastic   one cross-slice center exchange (elastic/randomsync)
@@ -45,8 +48,8 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-SITES = ("data.decode", "data.prefetch", "ckpt.save", "ckpt.restore",
-         "sync.elastic", "step.train")
+SITES = ("data.decode", "data.prefetch", "feed.stage", "ckpt.save",
+         "ckpt.restore", "sync.elastic", "step.train")
 
 KINDS = ("error", "preempt", "corrupt", "torn")
 

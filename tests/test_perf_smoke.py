@@ -1,0 +1,32 @@
+"""Perf smoke (ISSUE 2 satellite): the feed-pipeline A/B bench leg
+under the `perf` marker.  Marked `slow` too — it trains real (small)
+LeNet chunks three times — so tier-1 (`-m "not slow"`) skips it; run
+with `pytest -m perf` or scripts/perf_smoke.sh."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = [pytest.mark.perf, pytest.mark.slow]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_feed_smoke_records_host_wait_drop(tmp_path):
+    out = tmp_path / "BENCH_pr2.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--feed-smoke", "--out", str(out)],
+        check=True, env=env, cwd=REPO, timeout=1800)
+    r = json.loads(out.read_text())
+    assert r["metric"] == "lenet_feed_pipeline"
+    for leg in ("feeder_on", "feeder_off"):
+        assert r[leg]["steps_per_sec"] > 0
+        assert 0.0 <= r[leg]["host_wait_fraction"] < 1.0
+    # the acceptance property: overlap removes host data-wait from the
+    # critical path
+    assert r["value"] > 0, r

@@ -190,3 +190,32 @@ def test_init_gaussian_variants():
     cfg2 = ParamConfig(init_method="kGaussainSqrtFanIn", std=1.0)
     y = np.asarray(init_param(jax.random.PRNGKey(5), cfg2, (100, 50)))
     assert abs(y.std() - 0.1) < 0.01  # scaled by 1/sqrt(shape[0]=100)
+
+
+def test_default_multipliers_hoisted_to_construction():
+    """The default Multipliers pytree and the treedef must be derived
+    once (init / first structure seen), not rebuilt on every update
+    call — the update runs inside the scan body, so per-call tree
+    construction was paid on every trace (ISSUE 2 satellite)."""
+    cfg = UpdaterConfig(type="kSGD", base_learning_rate=0.1,
+                        learning_rate_change_method="kFixed")
+    up = Updater(cfg)
+    params = {"w": jnp.ones((4,)), "b": jnp.zeros((2,))}
+    state = up.init(params)
+    treedef = jax.tree_util.tree_structure(params)
+    assert treedef in up._default_mults          # pre-built at init
+    cached = up._default_mults[treedef]
+    grads = {"w": jnp.full((4,), 0.5), "b": jnp.full((2,), 0.25)}
+    p1, s1 = up.update(0, grads, params, state)
+    assert up._default_mults[treedef] is cached  # reused, not rebuilt
+    # and the defaulted path matches an explicit all-ones multiplier tree
+    mults = {"w": Multipliers(), "b": Multipliers()}
+    p2, _ = up.update(0, grads, params, state, multipliers=mults)
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(p1[k]), np.asarray(p2[k]))
+    # a DIFFERENT structure (the CD path updates param subsets) still works
+    sub_p = {"w": params["w"]}
+    sub_s = {"history": {"w": state["history"]["w"]}}
+    p3, _ = up.update(0, {"w": grads["w"]}, sub_p, sub_s)
+    np.testing.assert_array_equal(np.asarray(p3["w"]), np.asarray(p1["w"]))
+    assert len(up._default_mults) == 2
