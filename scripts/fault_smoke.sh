@@ -105,13 +105,105 @@ np.testing.assert_allclose(sup, base, rtol=1e-5, atol=1e-6)
 print("FAULT SMOKE PASS: recovered run matches the uninterrupted one")
 EOF
 
+# Divergence-rescue leg (ISSUE 3): a silent NaN injected into the
+# gradients after a good checkpoint must be detected by the health
+# monitor, rolled back past (skip_unhealthy restore), and the recovered
+# trajectory must match the uninterrupted run bit-for-bit.
+python - <<'EOF'
+import tempfile
+
+import numpy as np
+
+from singa_tpu.config.schema import model_config_from_dict
+from singa_tpu.core.supervisor import Supervisor
+from singa_tpu.core.trainer import Trainer
+from singa_tpu.data.pipeline import prefetch
+from singa_tpu.data.synthetic import synthetic_image_batches
+from singa_tpu.utils.faults import Backoff, FaultSchedule, inject
+from singa_tpu.utils.health import HealthMonitor, HealthSpec
+
+STEPS = 20
+SHAPES = {"data": {"pixel": (28, 28), "label": ()}}
+
+
+def cfg():
+    return model_config_from_dict({
+        "name": "divergence-smoke", "train_steps": STEPS,
+        "checkpoint_frequency": 5,
+        "updater": {"type": "kSGD", "base_learning_rate": 0.01,
+                    "learning_rate_change_method": "kFixed"},
+        "neuralnet": {"layer": [
+            {"name": "data", "type": "kShardData",
+             "data_param": {"batchsize": 8}},
+            {"name": "mnist", "type": "kMnistImage", "srclayers": "data",
+             "mnist_param": {"norm_a": 255.0}},
+            {"name": "label", "type": "kLabel", "srclayers": "data"},
+            {"name": "ip1", "type": "kInnerProduct", "srclayers": "mnist",
+             "inner_product_param": {"num_output": 32},
+             "param": [{"name": "w1",
+                        "init_method": "kUniformSqrtFanIn"},
+                       {"name": "b1"}]},
+            {"name": "ip2", "type": "kInnerProduct", "srclayers": "ip1",
+             "inner_product_param": {"num_output": 10},
+             "param": [{"name": "w2",
+                        "init_method": "kUniformSqrtFanIn"},
+                       {"name": "b2"}]},
+            {"name": "loss", "type": "kSoftmaxLoss",
+             "srclayers": ["ip2", "label"]}]}})
+
+
+def data_factory():
+    return prefetch(synthetic_image_batches(8, seed=7, stream_seed=111))
+
+
+tr0 = Trainer(cfg(), SHAPES, log_fn=lambda s: None, donate=False)
+p, o = tr0.init(seed=0)
+p_ref, _, _ = tr0.run(p, o, data_factory(), seed=0)
+
+mon = HealthMonitor(HealthSpec(), log_fn=print)
+tr = Trainer(cfg(), SHAPES, log_fn=print, donate=False, health=mon)
+with tempfile.TemporaryDirectory(prefix="divergence_smoke_") as ws:
+    sup = Supervisor(tr, ws, max_restarts=0,
+                     backoff=Backoff(base=0.05, cap=0.2, seed=0),
+                     log=print)
+    sched = FaultSchedule.parse("step.grad@12:nan", seed=0)
+    with inject(sched):
+        p_sup, _, _ = sup.run(data_factory, seed=0)
+assert [f.kind for f in sup.failures] == ["divergence"], sup.failures
+assert {f.site for f in sched.fired} == {"step.grad"}, sched.fired
+for k in p_ref:
+    assert np.all(np.isfinite(np.asarray(p_sup[k]))), k
+    np.testing.assert_array_equal(np.asarray(p_sup[k]),
+                                  np.asarray(p_ref[k]), err_msg=k)
+print("DIVERGENCE SMOKE PASS: NaN detected, rolled back, recovered "
+      "run matches the uninterrupted one bit-for-bit")
+EOF
+
 # CLI leg: the same machinery through singa_tpu.main's --max-restarts /
 # --fault_spec flags (synthetic data, supervised, one preemption)
 WS=$(mktemp -d -t fault_smoke_cli_XXXX)
-trap 'rm -rf "$WS"' EXIT
+CLEAN_LOG=$(mktemp -t fault_smoke_clean_XXXX)
+trap 'rm -rf "$WS" "$CLEAN_LOG"' EXIT
 python -m singa_tpu.main -model_conf examples/mnist/mlp.conf \
     --synthetic --steps 20 --workspace "$WS" \
     --max-restarts 3 --fault_spec "step.train@8:preempt" \
     | grep -E "fault injection active|supervisor|training done" || {
         echo "FAULT SMOKE CLI LEG FAILED"; exit 1; }
 echo "FAULT SMOKE CLI PASS"
+
+# Clean-run leg: with the health sentinel on and NO injection, nothing
+# may be flagged as poisoned and no divergence rescue may fire — a
+# false positive here would reject healthy sync rounds / checkpoints in
+# production.
+rm -rf "$WS"; mkdir -p "$WS"
+python -m singa_tpu.main -model_conf examples/mnist/mlp.conf \
+    --synthetic --steps 20 --workspace "$WS" --health on \
+    --max-restarts 3 > "$CLEAN_LOG" 2>&1 || {
+        cat "$CLEAN_LOG"; echo "CLEAN HEALTH RUN FAILED"; exit 1; }
+if grep -E "warning: .*poisoned|divergence|NONFINITE|refusing checkpoint" \
+        "$CLEAN_LOG"; then
+    echo "CLEAN HEALTH RUN FLAGGED FALSE POSITIVES"; exit 1
+fi
+grep -q "training done" "$CLEAN_LOG" || {
+    cat "$CLEAN_LOG"; echo "CLEAN HEALTH RUN DID NOT FINISH"; exit 1; }
+echo "CLEAN HEALTH RUN PASS: zero poisoned/divergence flags"

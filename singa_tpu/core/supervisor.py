@@ -19,6 +19,17 @@ rescheduled job does not sit out a backoff).  When the retry budget is
 exhausted the Supervisor raises a structured `TrainingAborted` carrying
 the full failure log.
 
+A third failure kind, `"divergence"` (utils.health.NumericDivergence —
+the trainer's health monitor found non-finite or exploding numerics),
+has its own budget and its own rescue policy: restore with
+`skip_unhealthy=True` so the walk-back lands on the last *numerically
+good* snapshot (not merely the last readable one — a snapshot taken in
+a spike window carries that verdict in MANIFEST.json), optionally skip
+`blame_batches` data batches at the crash step (bad-record blame), and
+optionally apply a one-shot learning-rate backoff before retrying.
+Like preemptions, divergences retry immediately — waiting does not fix
+arithmetic.
+
 Determinism contract (what makes recovery *testable*): the trainer's
 per-step rng is fold_in(seed, step) and the data factory rebuilds the
 same batch sequence, so restore-at-step-s + replay reproduces the
@@ -34,6 +45,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterator, List, Optional
 
 from ..utils.faults import Backoff, Preemption, retry_call
+from ..utils.health import NumericDivergence
 
 
 @dataclass
@@ -41,7 +53,7 @@ class FailureRecord:
     """One supervised-run failure, as carried by TrainingAborted and
     `Supervisor.failures`."""
     attempt: int
-    kind: str                 # "preemption" | "error"
+    kind: str                 # "preemption" | "error" | "divergence"
     error: str                # repr of the exception
     last_step: int            # last step a hook observed before the crash
     restart_step: int         # step the NEXT attempt resumed from
@@ -83,13 +95,26 @@ class Supervisor:
                  max_preemptions: Optional[int] = None,
                  backoff: Optional[Backoff] = None,
                  restore_retries: int = 3,
+                 max_divergences: int = 2,
+                 blame_batches: int = 0,
+                 lr_backoff: float = 0.0,
                  log: Optional[Callable[[str], None]] = None):
+        """`max_divergences`, `blame_batches`, `lr_backoff` configure
+        the numeric-divergence rescue policy (docstring above; the
+        trainer must carry a HealthMonitor for divergences to be
+        raised at all — main.py wires both from `--health_spec`)."""
         self.trainer = trainer
         self.workspace = workspace
         self.max_restarts = max(max_restarts, 0)
         self.max_preemptions = max_preemptions
         self.backoff = backoff or Backoff(base=0.5, cap=30.0, jitter=0.25)
         self.restore_retries = max(restore_retries, 1)
+        self.max_divergences = max(max_divergences, 0)
+        self.blame_batches = max(blame_batches, 0)
+        self.lr_backoff = lr_backoff
+        self._blame: set = set()      # global batch indices to skip
+        self._skip_unhealthy = False  # armed by the first divergence
+        self._lr_backed_off = False   # the backoff is one-shot
         self.log = log or trainer.log
         self.failures: List[FailureRecord] = []
         cfg = trainer.cfg
@@ -120,23 +145,36 @@ class Supervisor:
 
     def _restore(self, params, opt, seed: int):
         """RESTORE: latest valid snapshot, with its own (small) retry
-        budget — a flaky restore read is not a training failure."""
+        budget — a flaky restore read is not a training failure.  After
+        a divergence the restore also skips snapshots with a bad health
+        verdict (rollback PAST the unhealthy window)."""
         if not self.workspace:
             return params, opt, 0
         return retry_call(
-            lambda: self.trainer.resume(params, opt, self.workspace),
+            lambda: self.trainer.resume(
+                params, opt, self.workspace,
+                skip_unhealthy=self._skip_unhealthy),
             attempts=self.restore_retries,
             backoff=Backoff(base=0.1, cap=5.0, seed=seed),
             log=self.log, what="checkpoint restore")
 
-    @staticmethod
-    def _make_iter(factory: Callable[..., Iterator], start_step: int
-                   ) -> Iterator:
+    def _make_iter(self, factory: Callable[..., Iterator],
+                   start_step: int) -> Iterator:
         """Fast-forward the train stream to `start_step`.  A factory
         taking a positional arg receives the step (sources that can
         seek do so cheaply); otherwise `start_step` batches are drained
         from a fresh iterator — exact replay either way, because the
-        per-step path consumes exactly one batch per step."""
+        per-step path consumes exactly one batch per step.
+
+        With blamed batches (divergence rescue), the stream is rebuilt
+        from index 0, blamed indices are dropped, and the fast-forward
+        drains through the FILTERED stream — so the batch offset stays
+        exact across any number of later restarts."""
+        if self._blame:
+            it = self._drop_blamed(factory(), self._blame)
+            for _ in range(start_step):
+                next(it)
+            return it
         if start_step > 0:
             try:
                 sig = inspect.signature(factory)
@@ -152,6 +190,14 @@ class Supervisor:
         for _ in range(start_step):
             next(it)
         return it
+
+    @staticmethod
+    def _drop_blamed(it: Iterator, blame) -> Iterator:
+        """Yield `it` minus the batches at the blamed stream indices."""
+        for i, batch in enumerate(it):
+            if i in blame:
+                continue
+            yield batch
 
     def run(self, train_iter_factory: Callable[..., Iterator],
             test_iter_factory: Optional[Callable[[], Iterator]] = None,
@@ -171,7 +217,7 @@ class Supervisor:
         whose chunk plan starts at the restored step, and failures on
         the staging thread (the `feed.stage` site) surface on the
         consumer side like any step failure."""
-        errors = preemptions = 0
+        errors = preemptions = divergences = 0
         attempt = 0
         last_seen = [-1]
         probes = [lambda s, m: last_seen.__setitem__(0, s)]
@@ -179,6 +225,11 @@ class Supervisor:
             probes += list(hooks)
         while True:
             attempt += 1
+            monitor = getattr(self.trainer, "health", None)
+            if monitor is not None:
+                # rolling statistics from a poisoned attempt must not
+                # leak into the retry's classification
+                monitor.reset()
             params, opt = self._fresh_state(seed)
             start_step = 0
             if self.workspace and (resume or attempt > 1):
@@ -212,6 +263,14 @@ class Supervisor:
                 self.log(f"supervisor: preemption at ~step "
                          f"{last_seen[0]} ({e}); restarting "
                          f"immediately")
+            except NumericDivergence as e:
+                divergences += 1
+                self._record(attempt, "divergence", e, last_seen[0])
+                if divergences > self.max_divergences:
+                    raise self._abort(
+                        f"{divergences} numeric divergences exceed the "
+                        f"budget of {self.max_divergences}") from e
+                self._rescue(e)
             except Exception as e:  # noqa: BLE001 — any runtime failure
                 errors += 1
                 self._record(attempt, "error", e, last_seen[0])
@@ -233,6 +292,26 @@ class Supervisor:
                         close()
                     except Exception:  # pragma: no cover
                         pass
+
+    def _rescue(self, e: NumericDivergence) -> None:
+        """Divergence rescue policy: arm skip-unhealthy restores, blame
+        the batches at the crash step, and (once) back off the learning
+        rate.  Retries immediately — backoff sleeps don't fix NaNs."""
+        self._skip_unhealthy = True
+        actions = ["rolling back past the unhealthy window"]
+        if self.blame_batches > 0:
+            first = max(e.step, 0)
+            blamed = range(first, first + self.blame_batches)
+            self._blame.update(blamed)
+            actions.append(f"blaming batches "
+                           f"[{first}, {first + self.blame_batches})")
+        if self.lr_backoff and not self._lr_backed_off:
+            scale = self.trainer.apply_lr_backoff(self.lr_backoff)
+            self._lr_backed_off = True
+            actions.append(f"LR backoff x{self.lr_backoff:g} "
+                           f"(scale now {scale:g})")
+        self.log(f"supervisor: numeric divergence at step {e.step} "
+                 f"({e}); {'; '.join(actions)}; retrying immediately")
 
     def _record(self, attempt: int, kind: str, exc: BaseException,
                 last_step: int) -> None:

@@ -109,7 +109,7 @@ class Trainer:
                  input_shapes: Dict[str, Dict[str, tuple]],
                  log_fn: Callable[[str], None] = print,
                  donate: bool = True, mesh=None, n_micro: int = 0,
-                 ngroups: int = 1):
+                 ngroups: int = 1, health=None):
         """`mesh` + layers carrying locationid stage marks → the staged
         region runs pipelined over the mesh's "pipe" axis (see
         parallel.pipeline_net); `n_micro` sets the GPipe microbatch
@@ -122,10 +122,20 @@ class Trainer:
         center copy at sync_frequency after warmup_steps, exactly the
         reference worker's cadence (worker.cc:44-55); `ngroups` scales
         Elastic's alpha = moving_rate/ngroups (param_manager.cc:15).
-        Multi-replica groups run through parallel.elastic.ReplicaSet."""
+        Multi-replica groups run through parallel.elastic.ReplicaSet.
+
+        `health` (a utils.health.HealthMonitor) arms the numeric-health
+        sentinel: the compiled train step gains device-side probes
+        (grad/param norms, update ratio) that ride the deferred metrics
+        ring, the ring drain classifies each step, fatal verdicts raise
+        a structured NumericDivergence, and checkpoint saves carry (and
+        are gated on) the window's health verdict.  None (the default)
+        compiles exactly the pre-health step program."""
         self.cfg = model_cfg
         self.log = log_fn
         self.mesh = mesh
+        self.health = health
+        self._donate = donate
         self.compute_dtype = (jnp.bfloat16
                               if model_cfg.precision == "bfloat16" else None)
         self.train_net = build_net(model_cfg, "kTrain", input_shapes)
@@ -292,8 +302,20 @@ class Trainer:
         mesh, cdtype = self.mesh, self.compute_dtype
         net_apply = self._net_apply(net)
         copts = self._compiler_options()
+        # device-side numeric probes fuse into the step program only
+        # when a monitor is armed — the default compiles the exact
+        # pre-health program (and metrics dict)
+        health_on = self.health is not None
+        if health_on:
+            from ..utils.health import health_probes
+        # `poison` (None in every normal call — extra traced argument
+        # only when a step.grad fault fires) scales the gradients: NaN
+        # for the `nan` kind, SPIKE_SCALE for `spike` — the silent
+        # numeric failures the health tier exists to catch
+        poisoned = (lambda grads, pz: grads if pz is None else
+                    jax.tree_util.tree_map(lambda g: g * pz, grads))
 
-        def train_step(params, opt_state, batch, step, rng):
+        def train_step(params, opt_state, batch, step, rng, poison=None):
             def loss_fn(p):
                 loss, metrics, _ = net_apply(p, batch, rng=rng, train=True,
                                              mesh=mesh, compute_dtype=cdtype,
@@ -301,16 +323,20 @@ class Trainer:
                 return loss, metrics
             (loss, metrics), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-            params, opt_state = updater.update(step, grads, params, opt_state,
-                                               multipliers=mults)
-            return params, opt_state, metrics
+            grads = poisoned(grads, poison)
+            new_params, opt_state = updater.update(
+                step, grads, params, opt_state, multipliers=mults)
+            if health_on:
+                metrics = {**metrics,
+                           **health_probes(grads, params, new_params)}
+            return new_params, opt_state, metrics
 
         donate_args = (0, 1) if donate else ()
         self.train_step = jax.jit(train_step, donate_argnums=donate_args,
                                   compiler_options=copts)
 
         def train_scan(params, opt_state, batches, start_step, rng, nsteps,
-                       stacked=False):
+                       stacked=False, poison=None):
             """`nsteps` training steps in ONE compiled program (lax.scan).
 
             Removes the per-step host dispatch from the inner loop — the
@@ -319,12 +345,14 @@ class Trainer:
             process boundary per batch.  With `stacked=True` every leaf
             of `batches` carries a leading `nsteps` axis that is scanned
             over (a fresh batch per step); with the default False,
-            `batches` is a single batch reused every step.  Returns
-            stacked per-step metrics.
+            `batches` is a single batch reused every step.  `poison`
+            (None normally; an (nsteps,) grad-scale vector when a
+            step.grad fault fires inside the chunk) is scanned over
+            alongside the steps.  Returns stacked per-step metrics.
             """
             def body(carry, xs):
                 p, o = carry
-                step, batch = xs
+                step, batch, pz = xs
                 if batch is None:
                     batch = batches
                 step_rng = jax.random.fold_in(rng, step)
@@ -336,8 +364,13 @@ class Trainer:
                     return loss, metrics
                 (_, metrics), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(p)
-                p, o = updater.update(step, grads, p, o, multipliers=mults)
-                return (p, o), metrics
+                grads = poisoned(grads, pz)
+                new_p, o = updater.update(step, grads, p, o,
+                                          multipliers=mults)
+                if health_on:
+                    metrics = {**metrics,
+                               **health_probes(grads, p, new_p)}
+                return (new_p, o), metrics
 
             steps = start_step + jnp.arange(nsteps)
             if stacked:
@@ -347,7 +380,7 @@ class Trainer:
                     raise ValueError(
                         f"stacked=True needs a leading {nsteps}-axis on "
                         f"every batch leaf; got shapes {bad}")
-            xs = (steps, batches if stacked else None)
+            xs = (steps, batches if stacked else None, poison)
             # SINGA_TPU_SCAN_UNROLL replicates the step body in the
             # compiled loop (lax.scan unroll), trading compile time and
             # program size for fewer loop-iteration boundaries
@@ -713,10 +746,19 @@ class Trainer:
                              for i in range(n)] if stacked else [md])
                 for i, m in enumerate(per_step):
                     s = s0 + i
+                    if self.health is not None:
+                        # classify as the ring drains — the probes rode
+                        # the deferred metrics, so detection costs no
+                        # extra host sync; a fatal verdict aborts the
+                        # attempt BEFORE this step reaches hooks or a
+                        # checkpoint (the save below drains first)
+                        verdict = self.health.observe(s, m)
+                        if verdict.fatal:
+                            raise verdict.to_error()
                     self.perf.update(m)
                     if hooks:
                         for h in hooks:
-                            h(s, m)
+                            self._call_hook(h, s, m)
                     if self.display_now(s):
                         if (self.timer.phase_shares is None
                                 and (getattr(self, "phase_profile", False)
@@ -743,7 +785,7 @@ class Trainer:
                     _drain()   # hooks/logs for every trained step first
                     self.log(f"signal {interrupted[0]} received: checkpointing "
                              f"at step {step} and stopping")
-                    ckpt.save(step, *self._ckpt_state(params, opt_state))
+                    self._save_checkpoint(ckpt, step, params, opt_state)
                     break
                 if self.val_step and self.validate_now(step) and val_iter_factory:
                     _drain()
@@ -760,6 +802,7 @@ class Trainer:
                     history.append({"step": step, **avg})
 
                 n = self._next_chunk_len(step, scan_chunk) if chunked else 1
+                poison = self._grad_poison(n)
                 t0 = time.perf_counter()
                 if not chunked:
                     batch = next(train_iter)
@@ -768,7 +811,8 @@ class Trainer:
                     t2 = time.perf_counter()
                     params, opt_state, metrics = self.train_step(
                         params, opt_state, batch, step,
-                        jax.random.fold_in(rng, step))
+                        jax.random.fold_in(rng, step),
+                        poison[0] if poison is not None else None)
                     t3 = time.perf_counter()
                     pending.append((step, 1, metrics, False))
                     last_dbg[0] = batch
@@ -784,7 +828,7 @@ class Trainer:
                     t2 = t1
                     params, opt_state, metrics = self.train_steps(
                         params, opt_state, chunk.batches, step, rng, n,
-                        True)
+                        True, poison)
                     t3 = time.perf_counter()
                     pending.append((step, n, metrics, True))
                     last_dbg[0] = jax.tree_util.tree_map(
@@ -800,7 +844,8 @@ class Trainer:
                     stacked = stager.stage(batches)
                     t2 = time.perf_counter()
                     params, opt_state, metrics = self.train_steps(
-                        params, opt_state, stacked, step, rng, n, True)
+                        params, opt_state, stacked, step, rng, n, True,
+                        poison)
                     t3 = time.perf_counter()
                     pending.append((step, n, metrics, True))
                     last_dbg[0] = jax.tree_util.tree_map(
@@ -834,10 +879,13 @@ class Trainer:
                         and last >= self.cfg.checkpoint_after_steps
                         and (last + 1) % self.cfg.checkpoint_frequency == 0):
                     # drain BEFORE the save: every hook/metric below the
-                    # snapshot step has fired, so a crash-and-restore
-                    # never leaves a hook gap behind the resume point
+                    # snapshot step has fired (and the health monitor
+                    # has classified every step the snapshot contains),
+                    # so a crash-and-restore never leaves a hook gap —
+                    # and a poisoned state never reaches the save
                     _drain()
-                    ckpt.save(last + 1, *self._ckpt_state(params, opt_state))
+                    self._save_checkpoint(ckpt, last + 1, params,
+                                          opt_state)
                 step += n
             _drain()
         finally:
@@ -849,8 +897,69 @@ class Trainer:
             self._ckpt_unguard(old_handlers)
         if (ckpt is not None and not interrupted
                 and self.cfg.train_steps > start_step):
-            ckpt.save(self.cfg.train_steps, *self._ckpt_state(params, opt_state))
+            self._save_checkpoint(ckpt, self.cfg.train_steps, params,
+                                  opt_state)
         return params, opt_state, history
+
+    def _grad_poison(self, n: int):
+        """Consult the `step.grad` fault site once per step about to be
+        dispatched; an (n,) float32 scale vector when any fires, else
+        None (the common case — the compiled program is untouched and
+        no extra operand is transferred)."""
+        if faults.active() is None:
+            return None
+        from ..utils.health import SPIKE_SCALE
+        codes = [faults.maybe_fault("step.grad") for _ in range(n)]
+        if not any(codes):
+            return None
+        import numpy as np
+        scale = {"nan": float("nan"), "spike": SPIKE_SCALE}
+        return np.asarray([scale.get(c, 1.0) for c in codes], np.float32)
+
+    def _call_hook(self, hook, step, metrics) -> None:
+        """User hooks are observers, not training logic: one raising
+        must not look like a step failure (it would burn a Supervisor
+        restart) — log and continue."""
+        try:
+            hook(step, metrics)
+        except Exception as e:  # noqa: BLE001 — any user-hook failure
+            name = getattr(hook, "__name__", repr(hook))
+            self.log(f"warning: user hook {name} raised at step {step} "
+                     f"({type(e).__name__}: {e}); continuing")
+
+    def _save_checkpoint(self, ckpt, step, params, opt_state) -> bool:
+        """Cadence/final/signal snapshot, gated on the health verdict:
+        a window the monitor classified as fatal is REFUSED (restoring
+        it would faithfully resume the divergence), a suspect (spike)
+        window saves but carries its verdict in MANIFEST.json so
+        `skip_unhealthy` restores can walk past it."""
+        if ckpt is None:
+            return False
+        if self.health is None:
+            ckpt.save(step, *self._ckpt_state(params, opt_state))
+            return True
+        if not self.health.ok_to_save():
+            rec = self.health.snapshot_health()
+            self.log(f"health: refusing checkpoint at step {step} "
+                     f"(verdict {rec['verdict']!r} — restoring this "
+                     f"snapshot would resume the divergence)")
+            return False
+        ckpt.save(step, *self._ckpt_state(params, opt_state),
+                  health=self.health.snapshot_health())
+        self.health.mark_snapshot()
+        return True
+
+    def apply_lr_backoff(self, factor: float) -> float:
+        """Scale the effective learning rate by `factor` (the
+        Supervisor's divergence-rescue knob) and rebuild the compiled
+        steps — the schedule value is baked in at trace time, so the
+        jitted programs must be re-traced for the scale to apply.
+        Returns the cumulative scale."""
+        self.updater.lr_scale *= float(factor)
+        self._build_steps(self._donate)
+        self.log(f"health: learning-rate backoff x{factor:g} applied "
+                 f"(cumulative scale {self.updater.lr_scale:g})")
+        return self.updater.lr_scale
 
     def _ckpt_state(self, params, opt_state):
         """Checkpoint payload: padded-storage params/opt state (uneven
@@ -997,8 +1106,9 @@ class Trainer:
                     chains[idx] = chain_end
                 self.perf.update({"recon": recon})
                 if hooks:
+                    m_cd = {"recon": float(recon), "rbm": idx}
                     for h in hooks:
-                        h(step, {"recon": float(recon), "rbm": idx})
+                        self._call_hook(h, step, m_cd)
                 if self.display_now(step):
                     self.log(f"step-{step} cd[{rbm_names[idx]}]: "
                              f"{self.perf.to_string()}")
@@ -1015,9 +1125,13 @@ class Trainer:
             ckpt.save(total, *self._ckpt_state(params, opt_state))
         return params, opt_state, history
 
-    def resume(self, params, opt_state, workspace: str):
+    def resume(self, params, opt_state, workspace: str,
+               skip_unhealthy: bool = False):
         """Restore the latest snapshot (Worker::Resume, finally real).
-        Returns (params, opt_state, start_step).
+        Returns (params, opt_state, start_step).  `skip_unhealthy`
+        walks back past snapshots whose recorded health verdict is not
+        "ok" (the Supervisor's divergence rescue — restore the last
+        numerically GOOD state, not the last readable one).
 
         Checkpoints are saved spec-shaped (_ckpt_state unpads the
         pad-to-divisible storage of uneven partition dims), so the
@@ -1059,7 +1173,8 @@ class Trainer:
         tpl_o = {k: shard_tpl(t, opt_state.get(k, {}))
                  for k, t in tpl_o.items()}
         restored = CheckpointManager(workspace, log_fn=self.log).restore(
-            template={"params": tpl_p, "opt_state": tpl_o})
+            template={"params": tpl_p, "opt_state": tpl_o},
+            skip_unhealthy=skip_unhealthy)
         if restored is None:
             return params, opt_state, 0
         rp, ro, step = restored

@@ -89,6 +89,10 @@ class Updater:
     def __init__(self, cfg: UpdaterConfig):
         self.cfg = cfg
         self.type = cfg.type
+        # rescue-policy LR scale (Trainer.apply_lr_backoff): read at
+        # trace time, so changing it requires rebuilding the jitted
+        # steps; 1.0 leaves the traced program untouched
+        self.lr_scale = 1.0
         # default-Multipliers pytrees, keyed by param treedef: built
         # ONCE (at init / first update) instead of on every traced
         # update call — the update runs inside the scan body, so every
@@ -125,6 +129,8 @@ class Updater:
         if multipliers is None:
             multipliers = self._default_multipliers(treedef)
         lr = learning_rate(cfg, step) if cfg.base_learning_rate else 0.0
+        if self.lr_scale != 1.0:
+            lr = lr * self.lr_scale
 
         g_l = treedef.flatten_up_to(grads)
         m_l = jax.tree_util.tree_leaves(

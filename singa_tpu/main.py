@@ -52,6 +52,20 @@ def make_argparser() -> argparse.ArgumentParser:
                          "site@visit[:kind] entries, e.g. "
                          "'step.train@7:preempt,ckpt.save@1:torn' "
                          "(sites/kinds in singa_tpu/utils/faults.py)")
+    ap.add_argument("--health", choices=("on", "off"), default="on",
+                    help="numeric-health sentinel: device-side "
+                         "loss/grad-norm/update-ratio probes fused into "
+                         "the train step, host-side OK/SPIKE/NONFINITE/"
+                         "DIVERGED classification, checkpoint verdicts, "
+                         "and (under --max-restarts) divergence rescue "
+                         "(see docs/FAULT_TOLERANCE.md)")
+    ap.add_argument("--health_spec", default=None,
+                    help="health thresholds + rescue policy: comma-"
+                         "separated key=value entries over the "
+                         "HealthSpec fields, e.g. 'grad_norm_max=1e4,"
+                         "spike_mad=8,patience=3,blame_batches=1,"
+                         "lr_backoff=0.5' "
+                         "(singa_tpu/utils/health.py)")
     ap.add_argument("--workspace", default=None,
                     help="override ClusterProto.workspace")
     ap.add_argument("--scan_chunk", type=int, default=0,
@@ -137,10 +151,21 @@ def _run(args) -> int:
         ngroups = max(cluster.nworkers
                       // max(cluster.nprocs_per_group, 1), 1)
 
+    # numeric-health sentinel: probes compile into the train step only
+    # when armed; --health off restores the exact pre-health program
+    from .utils.health import HealthMonitor, HealthSpec
+    health_spec = HealthSpec.parse(args.health_spec)
+    health = (HealthMonitor(health_spec, log_fn=print)
+              if args.health == "on" else None)
+    if args.health == "off" and args.health_spec:
+        print("warning: --health_spec given with --health off; the "
+              "monitor is disabled and the spec only configures the "
+              "supervisor's divergence policy", file=sys.stderr)
+
     trainer = Trainer(model, input_shapes, mesh=mesh,
                       n_micro=(cluster.pipeline_microbatches
                                if cluster else 0),
-                      ngroups=ngroups)
+                      ngroups=ngroups, health=health)
     trainer.phase_profile = args.phase_profile
 
     from .parallel.elastic import async_active
@@ -250,7 +275,10 @@ def _run(args) -> int:
         # (Worker::Resume, worker.cc:65-67)
         from .core.supervisor import Supervisor, TrainingAborted
         sup = Supervisor(trainer, workspace,
-                         max_restarts=args.max_restarts, log=print)
+                         max_restarts=args.max_restarts,
+                         max_divergences=health_spec.max_divergences,
+                         blame_batches=health_spec.blame_batches,
+                         lr_backoff=health_spec.lr_backoff, log=print)
         try:
             params, opt_state, history = sup.run(
                 make_train_iter, test_iter_factory=test_factory,

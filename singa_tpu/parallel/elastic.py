@@ -24,6 +24,7 @@ multi-slice runtime.
 
 from __future__ import annotations
 
+import math
 from typing import Any, Dict, Tuple
 
 import jax
@@ -32,11 +33,23 @@ import numpy as np
 
 from ..config.schema import UpdaterConfig
 from ..utils.faults import Backoff, Preemption, maybe_fault
+from ..utils.health import SPIKE_SCALE, delta_health
 
 
 class SyncRoundSkipped(RuntimeError):
     """Internal signal: a center exchange failed past its retry budget;
     the caller degrades to 'skip this sync round'."""
+
+
+def _poisoned_contrib(params, kind):
+    """Honor a silent `sync.delta` fault: the replica's contribution is
+    numerically poisoned (NaN / scaled) BEFORE validation sees it —
+    the deterministic stand-in for a diverged replica or a corrupted
+    cross-slice transfer."""
+    if kind not in ("nan", "spike"):
+        return params
+    scale = float("nan") if kind == "nan" else SPIKE_SCALE
+    return jax.tree_util.tree_map(lambda x: x * scale, params)
 
 
 def sync_with_retries(exchange, *, attempts: int = 3,
@@ -159,7 +172,15 @@ class ElasticController:
     def __init__(self, cfg: UpdaterConfig, ngroups: int = 1,
                  bandwidth_mb_s: float = 0.0, nservers: int = 1,
                  log_fn=print, sync_retries: int = 3,
-                 sync_backoff: Backoff | None = None):
+                 sync_backoff: Backoff | None = None,
+                 validate: bool = True, delta_max_norm: float = 0.0,
+                 seed: int = 0, group: int = 0):
+        """`validate` rejects a non-finite (or, with `delta_max_norm`,
+        norm-exploded) replica contribution before it touches the
+        center — the poisoned round degrades to a skipped one (counted
+        in `poisoned_rounds`), exactly like a failed transport round.
+        `seed`/`group` seed the rng fallback so an rng-less
+        `maybe_sync` stays on the ReplicaSet trajectory contract."""
         self.cfg = cfg
         self.alpha = easgd_alpha(cfg, ngroups)
         self.mode = cfg.param_type           # "Elastic" | "RandomSync"
@@ -172,6 +193,11 @@ class ElasticController:
         self.sync_retries = max(sync_retries, 1)
         self.sync_backoff = sync_backoff
         self.skipped_rounds = 0
+        self.validate = validate
+        self.delta_max_norm = delta_max_norm
+        self.poisoned_rounds = 0
+        self.seed = seed
+        self.group = group
 
     def configure_sync(self, compute_time_s: float,
                        model_size_floats: int, nworkers: int) -> None:
@@ -193,32 +219,72 @@ class ElasticController:
     def sync_now(self, step: int) -> bool:
         return sync_now(self.cfg, step)
 
+    def _fallback_rng(self, step: int):
+        """The trajectory-exactness contract between ReplicaSet and
+        DistributedReplicaSet derives every exchange rng as
+        fold_in(fold_in(PRNGKey(seed ^ 0xA57), step), group) — the old
+        `PRNGKey(step)` default silently diverged from it, so a caller
+        omitting `rng` broke cross-runtime reproducibility."""
+        base = jax.random.PRNGKey(self.seed ^ 0xA57)
+        return jax.random.fold_in(jax.random.fold_in(base, step),
+                                  self.group)
+
     def maybe_sync(self, step: int, params, rng=None):
         """Exchange with the center at the cadence.  The center
         initializes lazily from the FIRST post-warmup params — the
         reference worker pushes its trained params to the servers after
         the warmup loop, before any sync (worker.cc:50-55); seeding the
         center from step-0 initialization would make the first exchange
-        snap the replica most of the way back toward init."""
+        snap the replica most of the way back toward init.
+
+        With `validate` (default), a poisoned contribution — non-finite,
+        or delta norm beyond `delta_max_norm` — never touches the
+        center: the round is rejected, `poisoned_rounds` counts it, and
+        the replica keeps training on its own params (the same
+        degradation as SyncRoundSkipped)."""
         if not self.sync_now(step):
             return params
         if self.center is None:
+            if self.validate:
+                ok, _ = delta_health(params)
+                if not ok:
+                    # a non-finite replica must not SEED the center
+                    self.poisoned_rounds += 1
+                    self.log(f"warning: poisoned params at center init "
+                             f"(step {step}): non-finite; round "
+                             f"skipped, center not seeded")
+                    return params
             self.init(params)
             return params
+        contrib = _poisoned_contrib(params, maybe_fault("sync.delta"))
         if self.mode == "RandomSync":
             if self.snapshot is None:
                 # replica joining an existing center (multi-group):
                 # its first delta baseline is its own current params
                 self.snapshot = jax.tree_util.tree_map(jnp.copy, params)
-            rng = rng if rng is not None else jax.random.PRNGKey(step)
+            rng = rng if rng is not None else self._fallback_rng(step)
+            ref = self.snapshot
 
             def exchange():
-                return randomsync_update(params, self.center,
+                return randomsync_update(contrib, self.center,
                                          self.snapshot,
                                          self.sample_ratio, rng)
         else:
+            ref = self.center
+
             def exchange():
-                return elastic_update(params, self.center, self.alpha)
+                return elastic_update(contrib, self.center, self.alpha)
+        if self.validate:
+            ok, norm = delta_health(contrib, ref,
+                                    max_norm=self.delta_max_norm)
+            if not ok:
+                self.poisoned_rounds += 1
+                self.log(f"warning: poisoned sync delta at step {step} "
+                         f"(delta norm {norm:.6g}"
+                         + (f" > cap {self.delta_max_norm:.6g}"
+                            if math.isfinite(norm) else ": non-finite")
+                         + "); rejecting exchange — center untouched")
+                return params
         try:
             out = sync_with_retries(exchange, attempts=self.sync_retries,
                                     backoff=self.sync_backoff,
@@ -256,13 +322,21 @@ class ReplicaSet:
     """
 
     def __init__(self, trainer, ngroups: int, seed: int = 0,
-                 bandwidth_mb_s: float = 0.0, nservers: int = 1):
+                 bandwidth_mb_s: float = 0.0, nservers: int = 1,
+                 quarantine_after: int = 3):
+        """`quarantine_after`: consecutive poisoned sync rounds (the
+        controller's delta validation rejecting a replica's
+        contribution) after which the replica is QUARANTINED — pulled
+        out of the round-robin instead of dragging the center with
+        divergent deltas round after round."""
         self.trainer = trainer
         self.ngroups = ngroups
+        self.quarantine_after = max(quarantine_after, 1)
         cfg = trainer.cfg.updater
         self.controllers = [ElasticController(
             cfg, ngroups, bandwidth_mb_s=bandwidth_mb_s,
-            nservers=nservers) for _ in range(ngroups)]
+            nservers=nservers, log_fn=trainer.log,
+            seed=seed, group=g) for g in range(ngroups)]
         self.replicas = []
         for g in range(ngroups):
             # every replica starts from the SAME initialization — the
@@ -271,7 +345,8 @@ class ReplicaSet:
             # replicas share a loss basin and their center average is
             # meaningful.  Divergence comes from the data streams.
             p, o = trainer.init(seed=seed)
-            self.replicas.append({"params": p, "opt": o})
+            self.replicas.append({"params": p, "opt": o,
+                                  "quarantined": False, "strikes": 0})
 
     def _share_center(self, src: ElasticController) -> None:
         # one LOGICAL center, but fresh containers per controller:
@@ -312,6 +387,8 @@ class ReplicaSet:
                 for c in self.controllers:
                     c.configure_sync(per_step, size, self.ngroups)
             for g, rep in enumerate(self.replicas):
+                if rep["quarantined"]:
+                    continue
                 batch = next(data_iters[g])
                 step_rng = jax.random.fold_in(
                     jax.random.fold_in(rng, step), g)
@@ -319,8 +396,25 @@ class ReplicaSet:
                     self.trainer.train_step(rep["params"], rep["opt"],
                                             batch, step, step_rng)
                 ctl = self.controllers[g]
+                poisoned_before = ctl.poisoned_rounds
                 rep["params"] = ctl.maybe_sync(step, rep["params"],
                                                rng=step_rng)
+                if ctl.poisoned_rounds > poisoned_before:
+                    # this replica's delta was rejected by validation;
+                    # repeated offenders are pulled from the rotation
+                    # instead of dragging the center every round
+                    rep["strikes"] += 1
+                    if rep["strikes"] >= self.quarantine_after:
+                        rep["quarantined"] = True
+                        self.trainer.log(
+                            f"warning: quarantining replica {g} at "
+                            f"step {step} after {rep['strikes']} "
+                            f"consecutive poisoned sync rounds — it no "
+                            f"longer trains or exchanges")
+                        continue
+                elif ctl.sync_now(step):
+                    # a completed clean round clears the streak
+                    rep["strikes"] = 0
                 if ctl.center is not None:
                     self._share_center(ctl)
                 history[g].append(
@@ -355,7 +449,8 @@ class DistributedReplicaSet:
     """
 
     def __init__(self, trainer, seed: int = 0,
-                 bandwidth_mb_s: float = 0.0, nservers: int = 1):
+                 bandwidth_mb_s: float = 0.0, nservers: int = 1,
+                 validate: bool = True, delta_max_norm: float = 0.0):
         self.trainer = trainer
         self.proc = jax.process_index()
         self.ngroups = jax.process_count()
@@ -371,8 +466,12 @@ class DistributedReplicaSet:
         self.params, self.opt = trainer.init(seed=seed)
         self._mesh = self._group_mesh()
         self._exchange = None
+        self._check = None
         self.sync_retries = 3
         self.skipped_rounds = 0
+        self.validate = validate
+        self.delta_max_norm = delta_max_norm
+        self.poisoned_rounds = 0
 
     def _group_mesh(self):
         from jax.sharding import Mesh
@@ -472,14 +571,60 @@ class DistributedReplicaSet:
         return jax.jit(exchange, static_argnums=(2,),
                        in_shardings=(grp, rep), out_shardings=(grp, rep))
 
-    def _sync(self, step: int, base_rng):
+    def _build_check(self):
+        """Per-replica delta validation as a replicated-output program:
+        every process computes the SAME (G,) ok/norm vectors from the
+        group-stacked global array, so the skip-a-poisoned-round
+        decision is symmetric across processes — no collective
+        deadlock (the same constraint the retry path documents)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self._mesh
+        grp = NamedSharding(mesh, P("group"))
+        rep = NamedSharding(mesh, P())
+
+        def check(stacked, ref, max_norm):
+            s_l = jax.tree_util.tree_leaves(stacked)
+            r_l = jax.tree_util.tree_leaves(ref)
+            G = s_l[0].shape[0]
+            sq = jnp.zeros((G,), jnp.float32)
+            finite = jnp.ones((G,), bool)
+            for s, r in zip(s_l, r_l):
+                d = (s - r[None]).astype(jnp.float32)
+                axes = tuple(range(1, d.ndim))
+                sq = sq + jnp.sum(jnp.square(d), axis=axes)
+                finite = jnp.logical_and(
+                    finite, jnp.all(jnp.isfinite(d), axis=axes))
+            norm = jnp.sqrt(sq)
+            ok = jnp.logical_and(finite, jnp.isfinite(norm))
+            ok = jnp.logical_and(
+                ok, jnp.where(max_norm > 0, norm <= max_norm, True))
+            return ok, norm
+
+        return jax.jit(check, in_shardings=(grp, rep, rep),
+                       out_shardings=(rep, rep))
+
+    def _sync(self, step: int, base_rng) -> bool:
+        """One center exchange.  Returns False when the round was
+        REJECTED by delta validation (a poisoned contribution — the
+        counted degradation, center untouched), True otherwise.
+
+        Commit discipline: all outputs (params / snapshot / center) are
+        computed and localized FIRST, then assigned in one straight-line
+        block — a failure mid-exchange (flaky DCN collective, injected
+        fault) can no longer leave `self.snapshot` updated while
+        `self.params` / `self._center_global` are stale, which made a
+        `sync_with_retries` re-entry exchange a fresh snapshot against
+        stale params (torn-state bug, ISSUE 3)."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         if self._exchange is None:
             self._exchange = self._build_exchange()
         rep = NamedSharding(self._mesh, P())
         init = self._center_global is None
-        stacked_r = self._stack(self.params)
+        contrib = _poisoned_contrib(self.params,
+                                    maybe_fault("sync.delta"))
+        stacked_r = self._stack(contrib)
         # replicated operands must be identical on every process
         # (device_put to a cross-process sharding verifies this); the
         # init-step center placeholder is zeros — the exchange program
@@ -489,6 +634,25 @@ class DistributedReplicaSet:
         center = (self._center_global if not init
                   else put_rep(jax.tree_util.tree_map(
                       jnp.zeros_like, self.params)))
+        if self.validate:
+            if self._check is None:
+                self._check = self._build_check()
+            # vs zeros on the init round a "delta" is the raw params,
+            # so only the finiteness leg applies there
+            cap = 0.0 if init else self.delta_max_norm
+            ok, norms = self._check(
+                stacked_r, center,
+                put_rep(jnp.asarray(cap, jnp.float32)))
+            ok = np.asarray(self._replicated(ok))
+            if not bool(ok.all()):
+                bad = [int(g) for g in np.nonzero(~ok)[0]]
+                norms = np.asarray(self._replicated(norms))
+                self.poisoned_rounds += 1
+                print(f"warning: poisoned sync delta at step {step} "
+                      f"from replica(s) {bad} (delta norms "
+                      f"{[float(norms[g]) for g in bad]}); rejecting "
+                      f"exchange — center untouched", flush=True)
+                return False
         if self.mode == "RandomSync":
             snap = (self.snapshot if self.snapshot is not None
                     else self.params)
@@ -497,11 +661,16 @@ class DistributedReplicaSet:
                 put_rep(jnp.asarray(self.sample_ratio, jnp.float32)),
                 put_rep(base_rng),
                 put_rep(jnp.asarray(step, jnp.uint32)), init)
-            self.snapshot = self._local(out_s)
+            new_snapshot = self._local(out_s)
         else:
             out_r, c = self._exchange(stacked_r, center, init)
-        self.params = self._local(out_r)
+            new_snapshot = self.snapshot
+        new_params = self._local(out_r)
+        # -- atomic commit: nothing above may have mutated self state --
+        self.params = new_params
+        self.snapshot = new_snapshot
         self._center_global = c
+        return True
 
     def run(self, data_iter, steps: int, seed: int = 0, hooks=None):
         """Train this process's replica for `steps` steps with center

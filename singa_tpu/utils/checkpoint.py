@@ -145,15 +145,38 @@ class CheckpointManager:
                      f"verifying snapshots by load only")
             return {}
 
-    def _manifest_record(self, step: int, path: str) -> None:
+    def _manifest_record(self, step: int, path: str,
+                         health: Optional[Dict[str, Any]] = None) -> None:
         man = self._read_manifest()
-        man[os.path.basename(path)] = {
+        entry: Dict[str, Any] = {
             "step": step,
             "size": os.path.getsize(path),
             "sha256": _sha256_file(path),
         }
+        if health is not None:
+            entry["health"] = health
+        man[os.path.basename(path)] = entry
         _atomic_write(self._manifest_path(),
                       json.dumps(man, indent=1, sort_keys=True).encode())
+
+    def _health_key(self, step: int) -> str:
+        """Manifest key carrying a snapshot's health record: the npz
+        file name on the fallback path, the bare step on orbax (whose
+        snapshot is a directory orbax owns — the manifest only rides
+        along as verdict metadata there)."""
+        return f"step_{step}.npz" if self._mgr is None else str(step)
+
+    def health_verdict(self, step: int) -> Optional[str]:
+        """The health verdict recorded at save time ("ok" / "spike" /
+        "diverged" / "nonfinite"), or None for snapshots saved without
+        a monitor (legacy/pre-health checkpoints — treated as ok by the
+        skip_unhealthy walk-back, matching pre-manifest snapshots being
+        load-verified only)."""
+        entry = self._read_manifest().get(self._health_key(step))
+        if not isinstance(entry, dict):
+            return None
+        health = entry.get("health")
+        return health.get("verdict") if isinstance(health, dict) else None
 
     def _verify_fallback(self, step: int) -> Optional[str]:
         """Path of a checksum-clean snapshot for `step`, else None
@@ -171,7 +194,12 @@ class CheckpointManager:
         return path
 
     def save(self, step: int, params: Dict[str, Any],
-             opt_state: Dict[str, Any]) -> None:
+             opt_state: Dict[str, Any],
+             health: Optional[Dict[str, Any]] = None) -> None:
+        """Snapshot the state triple.  `health` (from
+        HealthMonitor.snapshot_health) is recorded in MANIFEST.json so
+        `restore(skip_unhealthy=True)` can walk back past snapshots
+        taken in a numerically suspect window."""
         if self.latest_step() is not None:
             # never mix layouts in one directory: saving v-current into
             # a workspace still holding older-layout checkpoints would
@@ -186,6 +214,13 @@ class CheckpointManager:
             if act == "torn":
                 _tear(os.path.join(self.dir, str(step)))
                 return   # crash before the version stamp
+            if health is not None:
+                man = self._read_manifest()
+                man[self._health_key(step)] = {"step": step,
+                                               "health": health}
+                _atomic_write(self._manifest_path(),
+                              json.dumps(man, indent=1,
+                                         sort_keys=True).encode())
         else:
             path = os.path.join(self.dir, f"step_{step}.npz")
             flat = _flatten("", state)
@@ -205,7 +240,7 @@ class CheckpointManager:
                 # the platter; no manifest entry either (crash before)
                 _tear(path)
                 return
-            self._manifest_record(step, path)
+            self._manifest_record(step, path, health=health)
         # stamp only after a successful save: a failed save must not
         # mark the directory as holding current-layout checkpoints
         self._write_version()
@@ -223,14 +258,22 @@ class CheckpointManager:
         return steps[-1] if steps else None
 
     def restore(self, step: Optional[int] = None,
-                template: Optional[Dict[str, Any]] = None
+                template: Optional[Dict[str, Any]] = None,
+                skip_unhealthy: bool = False
                 ) -> Optional[Tuple[Dict, Dict, int]]:
         """Returns (params, opt_state, step) or None if no checkpoint.
 
         A corrupt/partial/unreadable snapshot at the requested (or
         latest) step does not fail the resume: it is logged and skipped,
         and the next older snapshot is tried — the previous *good*
-        checkpoint wins (TrainingAborted only when none is loadable)."""
+        checkpoint wins (TrainingAborted only when none is loadable).
+
+        With `skip_unhealthy`, snapshots whose recorded health verdict
+        is not "ok" (see `save`'s `health` record) are skipped the same
+        way: the restore walks back to the last *numerically good*
+        snapshot, not just the last readable one — the rollback the
+        Supervisor's divergence rescue relies on.  Snapshots with no
+        health record (saved without a monitor) count as ok."""
         steps = self.available_steps()
         if step is not None:
             steps = [s for s in steps if s <= step]
@@ -239,6 +282,13 @@ class CheckpointManager:
         self._check_version()
         faults.maybe_fault("ckpt.restore")
         for s in reversed(steps):
+            if skip_unhealthy:
+                verdict = self.health_verdict(s)
+                if verdict is not None and verdict != "ok":
+                    self.log(f"warning: checkpoint step {s} has health "
+                             f"verdict {verdict!r}; skipping to the "
+                             f"previous snapshot")
+                    continue
             try:
                 out = self._restore_one(s, template)
             except LayoutMismatchError:

@@ -22,7 +22,16 @@ restart — so a one-shot fault never re-fires during recovery):
     ckpt.save      one checkpoint save (before finalize)
     ckpt.restore   one checkpoint restore attempt
     sync.elastic   one cross-slice center exchange (elastic/randomsync)
+    sync.delta     one replica contribution handed to a center exchange
+                   (ElasticController.maybe_sync /
+                   DistributedReplicaSet._sync — the silent kinds
+                   poison the delta so validation/quarantine paths are
+                   testable)
     step.train     one training-loop iteration (Trainer.run / run_cd)
+    step.grad      one training step's gradients (Trainer.run consults
+                   per step; the silent kinds poison the compiled
+                   step's grads so numeric-health detection is
+                   testable on CPU)
 
 Fault kinds:
 
@@ -34,6 +43,13 @@ Fault kinds:
     torn     no exception — maybe_fault returns "torn" and the SITE
              decides how to honor it (ckpt.save writes a truncated
              snapshot: a save that "succeeded" but left garbage on disk)
+    nan      no exception — the site poisons the value with NaNs (a
+             silent numeric failure: grads at step.grad, the exchanged
+             delta at sync.delta) and training continues until the
+             health tier notices
+    spike    no exception — the site scales the value by a large factor
+             (an exploding-gradient / corrupted-delta event that stays
+             finite)
 
 Instrumented code calls `maybe_fault(site)` — a no-op returning None
 unless a schedule is active via `inject(schedule)`.  Overhead when
@@ -49,9 +65,15 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 SITES = ("data.decode", "data.prefetch", "feed.stage", "ckpt.save",
-         "ckpt.restore", "sync.elastic", "step.train")
+         "ckpt.restore", "sync.elastic", "sync.delta", "step.train",
+         "step.grad")
 
-KINDS = ("error", "preempt", "corrupt", "torn")
+KINDS = ("error", "preempt", "corrupt", "torn", "nan", "spike")
+
+#: kinds that do not raise: maybe_fault returns the kind string and the
+#: instrumented SITE decides how to honor it (tear a snapshot, poison a
+#: gradient or sync delta)
+SILENT_KINDS = ("torn", "nan", "spike")
 
 
 class FaultError(RuntimeError):
@@ -149,8 +171,8 @@ class FaultSchedule:
 
     def visit(self, site: str) -> Optional[str]:
         """Record one visit to `site`; raise / return the scheduled
-        fault if any.  Returns "torn" for the non-raising kind, None
-        otherwise."""
+        fault if any.  Returns the kind string for the non-raising
+        (silent) kinds — "torn", "nan", "spike" — None otherwise."""
         with self._lock:
             n = self._visits.get(site, 0)
             self._visits[site] = n + 1
@@ -166,8 +188,8 @@ class FaultSchedule:
             if kind is None:
                 return None
             self.fired.append(FiredFault(site, n, kind, time.time()))
-        if kind == "torn":
-            return "torn"
+        if kind in SILENT_KINDS:
+            return kind
         raise _KIND_EXC[kind](f"injected {kind} at {site} (visit {n})")
 
 

@@ -327,6 +327,31 @@ def test_elastic_sync_skips_round_after_budget():
     np.testing.assert_allclose(np.asarray(ctl.center["w"]), center_before)
 
 
+def test_user_hook_exception_is_isolated_not_a_training_error(tmp_path):
+    """A raising user hook must not look like a step failure: before
+    the fix it escaped Trainer.run, was recorded as a training "error",
+    and burned a Supervisor restart (plus a pointless restore+replay)."""
+    logs = []
+    tr = Trainer(_mlp_cfg(train_steps=6, ckpt_freq=2), SHAPES,
+                 log_fn=logs.append, donate=False)
+    sup = Supervisor(tr, str(tmp_path), max_restarts=0,
+                     backoff=_NO_WAIT, log=logs.append)
+    seen = []
+
+    def bad_hook(step, metrics):
+        if step == 2:
+            raise RuntimeError("observer bug")
+        seen.append(step)
+
+    p, _, _ = sup.run(_data_factory, seed=0, hooks=[bad_hook])
+    # no restart burned, every other step's hook still fired, loud log
+    assert sup.failures == []
+    assert seen == [0, 1, 3, 4, 5]
+    assert any("user hook" in l and "observer bug" in l for l in logs)
+    for k in p:
+        assert np.all(np.isfinite(np.asarray(p[k]))), k
+
+
 def test_trainer_restores_signal_handlers_after_mid_loop_failure(
         tmp_path):
     """An exception escaping the run loop must not leave the trainer's

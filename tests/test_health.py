@@ -1,0 +1,460 @@
+"""Numeric-health sentinel tests (ISSUE 3): device-side probes fused
+into the train step, host-side OK/SPIKE/NONFINITE/DIVERGED
+classification, checkpoint verdict quarantine (`skip_unhealthy`
+walk-back), Supervisor divergence rescue (rollback past the unhealthy
+window, blame-batch skip, one-shot LR backoff), and poisoned-sync
+rejection in the elastic tier.
+
+The acceptance property: inject `nan` at `step.grad` after a good
+checkpoint and the Supervisor restores the last *numerically good*
+snapshot, applies the rescue policy, and the trajectory from the
+rollback point is bit-identical to an uninterrupted run making the same
+skip/LR decisions."""
+
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from singa_tpu.config.schema import UpdaterConfig, model_config_from_dict
+from singa_tpu.core.supervisor import Supervisor, TrainingAborted
+from singa_tpu.core.trainer import Trainer
+from singa_tpu.data.synthetic import synthetic_image_batches
+from singa_tpu.utils import checkpoint as ckpt_mod
+from singa_tpu.utils.faults import Backoff, FaultSchedule, inject
+from singa_tpu.utils.health import (DIVERGED, NONFINITE, OK, SPIKE,
+                                    HealthMonitor, HealthSpec,
+                                    NumericDivergence, delta_health)
+
+pytestmark = [pytest.mark.faults, pytest.mark.health]
+
+SHAPES = {"data": {"pixel": (28, 28), "label": ()}}
+_NO_WAIT = Backoff(base=0.0, cap=0.0, jitter=0.0)
+
+
+def _mlp_cfg(train_steps=20, ckpt_freq=4):
+    return model_config_from_dict({
+        "name": "health-mlp", "train_steps": train_steps,
+        "checkpoint_frequency": ckpt_freq,
+        "updater": {"type": "kSGD", "base_learning_rate": 0.01,
+                    "learning_rate_change_method": "kFixed"},
+        "neuralnet": {"layer": [
+            {"name": "data", "type": "kShardData",
+             "data_param": {"batchsize": 8}},
+            {"name": "mnist", "type": "kMnistImage", "srclayers": "data",
+             "mnist_param": {"norm_a": 255.0}},
+            {"name": "label", "type": "kLabel", "srclayers": "data"},
+            {"name": "ip1", "type": "kInnerProduct", "srclayers": "mnist",
+             "inner_product_param": {"num_output": 16},
+             "param": [{"name": "w1",
+                        "init_method": "kUniformSqrtFanIn"},
+                       {"name": "b1"}]},
+            {"name": "ip2", "type": "kInnerProduct", "srclayers": "ip1",
+             "inner_product_param": {"num_output": 10},
+             "param": [{"name": "w2",
+                        "init_method": "kUniformSqrtFanIn"},
+                       {"name": "b2"}]},
+            {"name": "loss", "type": "kSoftmaxLoss",
+             "srclayers": ["ip2", "label"]}]}})
+
+
+def _data_factory():
+    return synthetic_image_batches(8, seed=3, stream_seed=104)
+
+
+def _baseline(train_steps=20):
+    tr = Trainer(_mlp_cfg(train_steps, ckpt_freq=0), SHAPES,
+                 log_fn=lambda s: None, donate=False)
+    p, o = tr.init(seed=0)
+    return tr.run(p, o, _data_factory(), seed=0)[0]
+
+
+# -- HealthSpec grammar ------------------------------------------------------
+def test_health_spec_parse_grammar():
+    s = HealthSpec.parse("grad_norm_max=1e4, spike_mad=8; patience=2,"
+                         "blame_batches=3,lr_backoff=0.5")
+    assert s.grad_norm_max == 1e4 and s.spike_mad == 8.0
+    assert s.patience == 2 and s.blame_batches == 3
+    assert s.lr_backoff == 0.5
+    assert HealthSpec.parse(None) == HealthSpec()
+    with pytest.raises(ValueError, match="bad health spec entry"):
+        HealthSpec.parse("nope=1")
+    with pytest.raises(ValueError, match="bad health spec value"):
+        HealthSpec.parse("window=abc")
+
+
+# -- monitor classification --------------------------------------------------
+def test_monitor_classifies_nonfinite_spike_diverged():
+    logs = []
+    mon = HealthMonitor(HealthSpec(grad_norm_max=100.0, warmup=4,
+                                   spike_mad=6, patience=2),
+                        log_fn=logs.append)
+    m = lambda loss, gn: {"loss": loss, "health/grad_norm": gn,  # noqa: E731
+                          "health/param_norm": 1.0,
+                          "health/update_ratio": 0.01}
+    for s in range(6):   # warm the window with steady values
+        assert mon.observe(s, m(1.0, 2.0)).status == OK
+    assert mon.observe(6, m(float("nan"), 2.0)).status == NONFINITE
+    assert mon.observe(7, m(1.0, 200.0)).status == DIVERGED  # hard cap
+    v = mon.observe(8, m(1.0, 50.0))                         # MAD spike
+    assert v.status == SPIKE and v.metric == "grad_norm"
+    # second consecutive spike escalates (patience=2)
+    assert mon.observe(9, m(1.0, 50.0)).status == DIVERGED
+    assert any("SPIKE" in l for l in logs)
+    # spikes never entered the rolling window
+    assert max(mon._windows["grad_norm"]) == 2.0
+
+
+def test_monitor_verdict_brackets_snapshots():
+    mon = HealthMonitor(HealthSpec(warmup=2, spike_mad=4, patience=10),
+                        log_fn=lambda s: None)
+    m = lambda gn: {"loss": 1.0, "health/grad_norm": gn}  # noqa: E731
+    for s in range(4):
+        mon.observe(s, m(1.0))
+    assert mon.snapshot_health()["verdict"] == OK and mon.ok_to_save()
+    mon.observe(4, m(100.0))   # SPIKE taints the window
+    assert mon.snapshot_health()["verdict"] == SPIKE
+    assert mon.ok_to_save()    # suspect still saves (marked)
+    mon.mark_snapshot()
+    assert mon.snapshot_health()["verdict"] == OK
+    mon.observe(5, m(float("inf")))
+    assert not mon.ok_to_save()  # fatal refuses the save
+
+
+# -- device-side probes ------------------------------------------------------
+def test_probes_ride_metrics_and_leave_trajectory_bitwise():
+    p_ref = _baseline(train_steps=6)
+    seen = {}
+    mon = HealthMonitor(HealthSpec(), log_fn=lambda s: None)
+    tr = Trainer(_mlp_cfg(6, ckpt_freq=0), SHAPES,
+                 log_fn=lambda s: None, donate=False, health=mon)
+    p, o = tr.init(seed=0)
+    p_h, _, _ = tr.run(p, o, _data_factory(), seed=0,
+                       hooks=[lambda s, m: seen.setdefault(s, m)])
+    for k in p_ref:   # probes are read-only: params bit-identical
+        np.testing.assert_array_equal(np.asarray(p_ref[k]),
+                                      np.asarray(p_h[k]), err_msg=k)
+    for key in ("health/grad_norm", "health/param_norm",
+                "health/update_ratio"):
+        assert key in seen[0] and np.isfinite(float(seen[0][key]))
+    assert mon.counts[OK] == 6
+
+
+def test_nan_at_step_grad_raises_structured_divergence():
+    mon = HealthMonitor(HealthSpec(), log_fn=lambda s: None)
+    tr = Trainer(_mlp_cfg(8, ckpt_freq=0), SHAPES,
+                 log_fn=lambda s: None, donate=False, health=mon)
+    p, o = tr.init(seed=0)
+    with inject(FaultSchedule.parse("step.grad@3:nan")):
+        with pytest.raises(NumericDivergence) as ei:
+            tr.run(p, o, _data_factory(), seed=0)
+    e = ei.value
+    assert (e.step, e.status, e.metric) == (3, NONFINITE, "grad_norm")
+
+
+# -- checkpoint quarantine ---------------------------------------------------
+def test_skip_unhealthy_restore_walks_past_bad_verdict(tmp_path,
+                                                       monkeypatch):
+    monkeypatch.setattr(ckpt_mod, "_HAVE_ORBAX", False)
+    mgr = ckpt_mod.CheckpointManager(str(tmp_path), log_fn=lambda s: None)
+    state = lambda v: ({"w": np.full(3, v)}, {"history": {"w": np.zeros(3)}})  # noqa: E731
+    mgr.save(4, *state(4.0), health={"verdict": "ok"})
+    mgr.save(8, *state(8.0), health={"verdict": "spike",
+                                     "grad_norm": 1e5})
+    mgr.save(12, *state(12.0), health={"verdict": "diverged"})
+    # default restore: latest readable wins regardless of verdict
+    _, _, step = mgr.restore()
+    assert step == 12
+    logs = []
+    mgr.log = logs.append
+    params, _, step = mgr.restore(skip_unhealthy=True)
+    assert step == 4
+    np.testing.assert_allclose(params["w"], 4.0)
+    assert sum("health verdict" in l for l in logs) == 2
+    assert mgr.health_verdict(8) == "spike"
+    assert mgr.health_verdict(4) == "ok"
+
+
+def test_trainer_refuses_checkpoint_of_fatal_window(tmp_path,
+                                                    monkeypatch):
+    monkeypatch.setattr(ckpt_mod, "_HAVE_ORBAX", False)
+    logs = []
+    mon = HealthMonitor(HealthSpec(), log_fn=lambda s: None)
+    tr = Trainer(_mlp_cfg(4, ckpt_freq=2), SHAPES, log_fn=logs.append,
+                 donate=False, health=mon)
+    mon.observe(0, {"loss": float("nan")})   # poison the window
+    ckpt = ckpt_mod.CheckpointManager(str(tmp_path),
+                                      log_fn=lambda s: None)
+    p, o = tr.init(seed=0)
+    assert tr._save_checkpoint(ckpt, 2, p, o) is False
+    assert ckpt.latest_step() is None
+    assert any("refusing checkpoint" in l for l in logs)
+
+
+# -- Supervisor divergence rescue (the acceptance property) ------------------
+def test_supervisor_rescue_rolls_back_past_unhealthy_checkpoint(
+        tmp_path, monkeypatch):
+    """spike at step 9 taints the step-12 snapshot (saved with verdict
+    "spike"); nan at step 13 is fatal.  The rescue must walk back PAST
+    the tainted snapshot to step 8, replay (the one-shot faults do not
+    re-fire), and land bit-identical to an uninterrupted run."""
+    monkeypatch.setattr(ckpt_mod, "_HAVE_ORBAX", False)
+    p_ref = _baseline()
+
+    spec = HealthSpec(grad_norm_max=0.0, update_ratio_max=0.0,
+                      spike_mad=8, patience=10)
+    logs = []
+    mon = HealthMonitor(spec, log_fn=logs.append)
+    tr = Trainer(_mlp_cfg(), SHAPES, log_fn=logs.append, donate=False,
+                 health=mon)
+    sup = Supervisor(tr, str(tmp_path), max_restarts=0,
+                     backoff=_NO_WAIT, log=logs.append)
+    sched = FaultSchedule.parse("step.grad@9:spike,step.grad@13:nan")
+    with inject(sched):
+        p_sup, _, _ = sup.run(_data_factory, seed=0)
+    assert [f.kind for f in sup.failures] == ["divergence"]
+    assert sorted(f.site for f in sched.fired) == ["step.grad"] * 2
+    assert any("verdict 'spike'; skipping" in l for l in logs), logs
+    assert any("resumed from step 8" in l for l in logs), logs
+    for k in p_ref:
+        assert np.all(np.isfinite(np.asarray(p_sup[k]))), k
+        np.testing.assert_array_equal(np.asarray(p_sup[k]),
+                                      np.asarray(p_ref[k]), err_msg=k)
+
+
+def test_supervisor_rescue_on_chunked_scan_loop(tmp_path, monkeypatch):
+    monkeypatch.setattr(ckpt_mod, "_HAVE_ORBAX", False)
+    p_ref = _baseline()
+    mon = HealthMonitor(HealthSpec(), log_fn=lambda s: None)
+    tr = Trainer(_mlp_cfg(), SHAPES, log_fn=lambda s: None,
+                 donate=False, health=mon)
+    sup = Supervisor(tr, str(tmp_path), max_restarts=0,
+                     backoff=_NO_WAIT, log=lambda s: None)
+    with inject(FaultSchedule.parse("step.grad@13:nan")):
+        p_sup, _, _ = sup.run(_data_factory, seed=0, scan_chunk=5)
+    assert [f.kind for f in sup.failures] == ["divergence"]
+    for k in p_ref:
+        np.testing.assert_array_equal(np.asarray(p_sup[k]),
+                                      np.asarray(p_ref[k]), err_msg=k)
+
+
+def test_supervisor_divergence_budget_is_separate(tmp_path,
+                                                  monkeypatch):
+    monkeypatch.setattr(ckpt_mod, "_HAVE_ORBAX", False)
+    mon = HealthMonitor(HealthSpec(), log_fn=lambda s: None)
+    tr = Trainer(_mlp_cfg(8, ckpt_freq=2), SHAPES,
+                 log_fn=lambda s: None, donate=False, health=mon)
+    sup = Supervisor(tr, str(tmp_path), max_restarts=5,
+                     max_divergences=1, backoff=_NO_WAIT,
+                     log=lambda s: None)
+    # two separate nan injections (visit 3 = step 3 of attempt 1;
+    # after the step-2 restore, visit 6 = step 4 of attempt 2): the
+    # second blows the divergence budget even though the error budget
+    # (5) has plenty left
+    sched = FaultSchedule.parse("step.grad@3:nan,step.grad@6:nan")
+    with inject(sched), pytest.raises(TrainingAborted) as ei:
+        sup.run(_data_factory, seed=0)
+    assert "numeric divergences exceed" in str(ei.value)
+    assert [f.kind for f in ei.value.failures] == ["divergence"] * 2
+
+
+def test_supervisor_blame_batches_and_lr_backoff_deterministic(
+        tmp_path, monkeypatch):
+    """The rescue policy's trajectory is reproducible: an uninterrupted
+    run that makes the SAME decisions (skip the blamed batches from the
+    rollback point, train with the backed-off LR) lands bit-identical."""
+    monkeypatch.setattr(ckpt_mod, "_HAVE_ORBAX", False)
+    mon = HealthMonitor(HealthSpec(), log_fn=lambda s: None)
+    tr = Trainer(_mlp_cfg(), SHAPES, log_fn=lambda s: None,
+                 donate=False, health=mon)
+    logs = []
+    sup = Supervisor(tr, str(tmp_path), max_restarts=0,
+                     backoff=_NO_WAIT, blame_batches=2, lr_backoff=0.5,
+                     log=logs.append)
+    with inject(FaultSchedule.parse("step.grad@13:nan")):
+        p_sup, _, _ = sup.run(_data_factory, seed=0)
+    assert tr.updater.lr_scale == 0.5
+    assert any("blaming batches [13, 15)" in l for l in logs), logs
+
+    # manual baseline: plain run to the rollback point (step 12), then
+    # continue with lr*0.5 and stream indices 13,14 dropped
+    tr_a = Trainer(_mlp_cfg(12, ckpt_freq=0), SHAPES,
+                   log_fn=lambda s: None, donate=False)
+    p, o = tr_a.init(seed=0)
+    p12, o12, _ = tr_a.run(p, o, _data_factory(), seed=0)
+    tr_b = Trainer(_mlp_cfg(20, ckpt_freq=0), SHAPES,
+                   log_fn=lambda s: None, donate=False)
+    tr_b.updater.lr_scale = 0.5
+    tr_b._build_steps(False)
+
+    def skipping():
+        for i, b in enumerate(_data_factory()):
+            if i not in (13, 14):
+                yield b
+    it = skipping()
+    for _ in range(12):
+        next(it)
+    p_base, _, _ = tr_b.run(p12, o12, it, seed=0, start_step=12)
+    for k in p_base:
+        np.testing.assert_array_equal(np.asarray(p_sup[k]),
+                                      np.asarray(p_base[k]), err_msg=k)
+
+
+# -- poisoned-sync rejection -------------------------------------------------
+def _elastic_ctl(**kw):
+    from singa_tpu.parallel.elastic import ElasticController
+    cfg = UpdaterConfig(type="kSGD", base_learning_rate=0.1,
+                        param_type="Elastic", moving_rate=0.5,
+                        sync_frequency=1, warmup_steps=0)
+    return ElasticController(cfg, log_fn=lambda s: None,
+                             sync_backoff=_NO_WAIT, **kw)
+
+
+def test_poisoned_sync_delta_rejected_center_untouched():
+    logs = []
+    ctl = _elastic_ctl()
+    ctl.log = logs.append
+    params = ctl.maybe_sync(0, {"w": jnp.full((4,), 2.0)})  # center init
+    center_before = np.asarray(ctl.center["w"]).copy()
+    with inject(FaultSchedule.parse("sync.delta@0:nan")):
+        out = ctl.maybe_sync(1, {"w": jnp.full((4,), 5.0)})
+    assert ctl.poisoned_rounds == 1
+    # degraded like SyncRoundSkipped: replica keeps its params, the
+    # center never saw the NaNs
+    np.testing.assert_allclose(np.asarray(out["w"]), 5.0)
+    np.testing.assert_allclose(np.asarray(ctl.center["w"]),
+                               center_before)
+    assert any("poisoned" in l for l in logs)
+
+
+def test_sync_delta_norm_cap_rejects_finite_explosion():
+    ctl = _elastic_ctl(delta_max_norm=1.0)
+    ctl.maybe_sync(0, {"w": jnp.zeros(4)})
+    out = ctl.maybe_sync(1, {"w": jnp.full((4,), 100.0)})  # |Δ| = 200
+    assert ctl.poisoned_rounds == 1
+    np.testing.assert_allclose(np.asarray(ctl.center["w"]), 0.0)
+    np.testing.assert_allclose(np.asarray(out["w"]), 100.0)
+
+
+def test_nonfinite_params_never_seed_the_center():
+    ctl = _elastic_ctl()
+    out = ctl.maybe_sync(0, {"w": jnp.full((4,), float("nan"))})
+    assert ctl.center is None and ctl.poisoned_rounds == 1
+    assert np.all(np.isnan(np.asarray(out["w"])))
+
+
+def test_spike_kind_poisons_but_validation_off_lets_it_through():
+    """The hazard the validation exists for: with validate=False a
+    poisoned delta corrupts the center."""
+    ctl = _elastic_ctl(validate=False)
+    ctl.maybe_sync(0, {"w": jnp.zeros(4)})
+    with inject(FaultSchedule.parse("sync.delta@0:nan")):
+        ctl.maybe_sync(1, {"w": jnp.full((4,), 5.0)})
+    assert np.all(np.isnan(np.asarray(ctl.center["w"])))
+    assert ctl.poisoned_rounds == 0
+
+
+def test_rng_fallback_matches_replicaset_fold_in_scheme():
+    cfg = UpdaterConfig(type="kSGD", base_learning_rate=0.1,
+                        param_type="RandomSync", sync_frequency=1,
+                        warmup_steps=0)
+    from singa_tpu.parallel.elastic import ElasticController
+    mk = lambda: ElasticController(cfg, log_fn=lambda s: None,  # noqa: E731
+                                   seed=7, group=1)
+    c1, c2 = mk(), mk()
+    for c in (c1, c2):
+        c.init({"w": jnp.zeros(100, jnp.float32)})
+        c.snapshot = {"w": jnp.zeros(100, jnp.float32)}
+        c.sample_ratio = 0.5
+    p = {"w": jnp.arange(100, dtype=jnp.float32)}
+    base = jax.random.PRNGKey(7 ^ 0xA57)
+    explicit = jax.random.fold_in(jax.random.fold_in(base, 3), 1)
+    o1 = c1.maybe_sync(3, p)                 # fallback derivation
+    o2 = c2.maybe_sync(3, p, rng=explicit)   # the contract's rng
+    np.testing.assert_array_equal(np.asarray(o1["w"]),
+                                  np.asarray(o2["w"]))
+
+
+def test_replica_set_quarantines_repeat_offender():
+    import sys
+    sys.path.insert(0, "tests")
+    from test_elastic import _mlp_cfg as elastic_cfg
+
+    from singa_tpu.parallel.elastic import ReplicaSet
+    cfg = elastic_cfg(moving_rate=0.9, sync_frequency=1, warmup=0,
+                      steps=0)
+    logs = []
+    tr = Trainer(cfg, SHAPES, log_fn=logs.append, donate=False)
+    rs = ReplicaSet(tr, ngroups=2, seed=0, quarantine_after=3)
+    iters = [synthetic_image_batches(32, seed=11, stream_seed=60 + g)
+             for g in range(2)]
+    # round-robin visits: step 0 -> g0 seeds the center (no visit),
+    # g1 visit 0; then g0/g1 alternate — visits 0,2,4 are replica 1
+    sched = FaultSchedule.parse(
+        "sync.delta@0:nan,sync.delta@2:nan,sync.delta@4:nan")
+    with inject(sched):
+        center, hist = rs.run(iters, steps=6, seed=0)
+    assert rs.replicas[1]["quarantined"] and rs.controllers[1].poisoned_rounds == 3
+    assert not rs.replicas[0]["quarantined"]
+    assert len(hist[1]) < len(hist[0])      # it stopped training
+    for v in center.values():               # center stayed clean
+        assert np.all(np.isfinite(np.asarray(v)))
+    assert any("quarantining replica 1" in l for l in logs)
+
+
+def test_distributed_sync_commits_atomically_and_rejects_poison():
+    """Single-process DistributedReplicaSet: (a) a failure mid-exchange
+    leaves params/snapshot/center ALL unchanged (the torn-state fix —
+    previously a crash between the three assignments left the snapshot
+    ahead of the params); (b) a poisoned contribution is rejected with
+    `poisoned_rounds` counted and no state change."""
+    import sys
+    sys.path.insert(0, "tests")
+    from test_elastic import _mlp_cfg as elastic_cfg
+
+    from singa_tpu.parallel.elastic import DistributedReplicaSet
+    cfg = elastic_cfg(moving_rate=0.0, sync_frequency=1, warmup=0,
+                      steps=0, param_type="RandomSync")
+    tr = Trainer(cfg, SHAPES, log_fn=lambda s: None, donate=False)
+    drs = DistributedReplicaSet(tr, seed=0)
+    rng = jax.random.PRNGKey(0)
+    assert drs._sync(0, rng) and drs._sync(1, rng)
+
+    def snap():
+        return ({k: np.asarray(v).copy() for k, v in drs.params.items()},
+                {k: np.asarray(v).copy()
+                 for k, v in drs.snapshot.items()},
+                {k: np.asarray(v).copy()
+                 for k, v in drs._replicated(drs._center_global).items()})
+
+    before = snap()
+    exchange = drs._exchange
+    drs._exchange = lambda *a, **k: (_ for _ in ()).throw(
+        RuntimeError("mid-sync failure"))
+    with pytest.raises(RuntimeError, match="mid-sync"):
+        drs._sync(2, rng)
+    after = snap()
+    for b, a in zip(before, after):
+        for k in b:
+            np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+    drs._exchange = exchange
+    with inject(FaultSchedule.parse("sync.delta@0:nan")):
+        assert drs._sync(3, rng) is False
+    assert drs.poisoned_rounds == 1
+    after = snap()
+    for b, a in zip(before, after):
+        for k in b:
+            np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+# -- delta_health helper -----------------------------------------------------
+def test_delta_health_finite_and_norm():
+    ok, norm = delta_health({"w": jnp.ones(4)}, {"w": jnp.zeros(4)})
+    assert ok and norm == pytest.approx(2.0)
+    ok, norm = delta_health({"w": jnp.array([1.0, float("nan")])})
+    assert not ok
+    ok, _ = delta_health({"w": jnp.full(4, 10.0)}, max_norm=1.0)
+    assert not ok
