@@ -357,6 +357,155 @@ def bench_feed_smoke(batch_size=64, steps=60, scan_chunk=10,
     return result
 
 
+def bench_serve_smoke(n_clients=6, reqs_per_client=5, out=None):
+    """Serving-tier smoke (ISSUE 5 acceptance): N concurrent clients
+    sustain traffic against the HTTP frontend on CPU, and the run
+    FAILS (raises) unless:
+      * zero program compiles after warmup (the compiled-bucket
+        contract — every request padded into an AOT executable);
+      * a mid-run checkpoint hot-reload lands with zero dropped or
+        failed in-flight requests;
+      * an injected `serve.reload` fault degrades to serving the OLD
+        params (counted in ServeStats.reload_failures, params_step
+        unmoved, process up) and the next clean poll recovers.
+    Records p50/p95 latency, occupancy, and QPS; `out` writes the JSON
+    line to a file as well (scripts/serve_smoke.sh -> BENCH_pr5.json).
+    The model is bench-tiny (2L 32E vocab 64): the subject under test
+    is the serving machinery, not the matmuls."""
+    import json as _json
+    import tempfile
+    import threading
+    import urllib.request
+
+    import jax
+
+    from singa_tpu.core.net import build_net
+    from singa_tpu.models.transformer import transformer_lm
+    from singa_tpu.serve import InferenceEngine, InferenceServer, ServeSpec
+    from singa_tpu.utils.checkpoint import CheckpointManager
+    from singa_tpu.utils.faults import FaultSchedule, inject
+
+    vocab, seq = 64, 16
+    cfg = transformer_lm(vocab_size=vocab, num_layers=2, embed_dim=32,
+                         num_heads=4, head_dim=8, seq_len=seq,
+                         batchsize=2)
+    net = build_net(cfg, "kTest",
+                    {"data": {"input": (seq,), "target": (seq,)}})
+    params = net.init_params(jax.random.PRNGKey(0))
+    opt = {"t": np.zeros(())}
+
+    ws = tempfile.mkdtemp(prefix="serve_smoke_")
+    mgr = CheckpointManager(ws, max_to_keep=10, log_fn=lambda s: None)
+    mgr.save(1, params, opt, health={"verdict": "ok"})
+
+    spec = ServeSpec(buckets=((2, 8), (4, 8), (4, 16)),
+                     max_new_tokens=8, batch_window_s=0.01,
+                     request_timeout_s=30.0, reload_poll_s=100.0)
+    engine = InferenceEngine(net, spec, workspace=ws,
+                             log_fn=lambda s: None)
+    engine.load()
+    warm = engine.warmup()
+
+    server = InferenceServer(engine, port=0, log_fn=lambda s: None)
+    server.start()
+    host, port = server.address
+    url = f"http://{host}:{port}"
+
+    def post(path, payload):
+        req = urllib.request.Request(
+            f"{url}{path}", data=_json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return _json.loads(r.read())
+
+    errors, results = [], []
+    rng = np.random.default_rng(0)
+    prompts = [[rng.integers(1, vocab, rng.integers(1, 13)).tolist()
+                for _ in range(reqs_per_client)]
+               for _ in range(n_clients)]
+
+    def client(i):
+        try:
+            for p in prompts[i]:
+                results.append(post("/generate", {"tokens": p}))
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(repr(e))
+
+    # mid-run hot reload: clients in flight while the params swap
+    p2 = jax.tree_util.tree_map(lambda a: a * 1.01, params)
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    mgr.save(2, p2, opt, health={"verdict": "ok"})
+    r1 = engine.poll_reload()
+    # injected reload fault mid-traffic: must degrade, not crash
+    mgr.save(3, params, opt, health={"verdict": "ok"})
+    with inject(FaultSchedule.parse("serve.reload@0:error")):
+        r2 = engine.poll_reload()
+    step_after_fault = engine.params_step
+    r3 = engine.poll_reload()   # clean poll recovers
+    for t in threads:
+        t.join()
+
+    # read the final stats through the HTTP endpoint — the same surface
+    # an operator scrapes
+    with urllib.request.urlopen(f"{url}/stats", timeout=10) as r:
+        snap = _json.loads(r.read())
+    server.stop()
+
+    n_total = n_clients * reqs_per_client
+    failures = []
+    if errors:
+        failures.append(f"client errors: {errors}")
+    if len(results) != n_total or snap["completed"] < n_total:
+        failures.append(f"dropped requests: {len(results)}/{n_total} "
+                        f"responses, {snap['completed']} completed")
+    if snap["failed"] or snap["expired"]:
+        failures.append(f"failed={snap['failed']} "
+                        f"expired={snap['expired']}")
+    if snap["compiles"] != warm:
+        failures.append(f"recompiled after warmup: {snap['compiles']} "
+                        f"!= {warm}")
+    if r1 != "reloaded":
+        failures.append(f"mid-run hot reload did not land: {r1}")
+    if r2 != "failed" or step_after_fault != 2:
+        failures.append(f"reload fault did not degrade to old params: "
+                        f"{r2}, step {step_after_fault}")
+    if snap["reload_failures"] != 1:
+        failures.append(f"reload failure not counted: "
+                        f"{snap['reload_failures']}")
+    if r3 != "reloaded" or snap["params_step"] != 3:
+        failures.append(f"post-fault recovery failed: {r3}, "
+                        f"step {snap['params_step']}")
+    if failures:
+        raise RuntimeError("serve smoke FAILED: " + "; ".join(failures))
+
+    result = {
+        "metric": "serve_smoke_p50_latency",
+        "value": snap["p50_latency_ms"],
+        "unit": "ms",
+        "p95_latency_ms": snap["p95_latency_ms"],
+        "batch_occupancy": snap["batch_occupancy"],
+        "qps": snap["qps"],
+        "requests": n_total,
+        "clients": n_clients,
+        "batches": snap["batches"],
+        "compiles_warmup": warm,
+        "compiles_total": snap["compiles"],
+        "reloads": snap["reloads"],
+        "reload_failures": snap["reload_failures"],
+        "served_step": snap["params_step"],
+        "buckets": [list(b) for b in spec.buckets],
+        "backend": __import__("jax").default_backend(),
+    }
+    line = json.dumps(result)
+    if out:
+        with open(out, "w") as f:
+            f.write(line + "\n")
+    return result
+
+
 def _convergence_aux():
     path = os.path.join(REPO, "CONVERGENCE.json")
     if not os.path.exists(path):
@@ -383,6 +532,12 @@ def main() -> None:
         if "--out" in sys.argv:
             out = sys.argv[sys.argv.index("--out") + 1]
         print(json.dumps(bench_feed_smoke(out=out)))
+        return
+    if "--serve-smoke" in sys.argv:
+        out = None
+        if "--out" in sys.argv:
+            out = sys.argv[sys.argv.index("--out") + 1]
+        print(json.dumps(bench_serve_smoke(out=out)))
         return
     # transformer FIRST: round 3 recorded it at 0.4996 because it ran
     # after the full AlexNet bench on a session-warmed chip; the

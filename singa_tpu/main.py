@@ -10,6 +10,12 @@ jax.distributed process coordinates for multi-host runs.
 Usage:
     python -m singa_tpu.main -model_conf examples/mnist/conv.conf \
         -cluster_conf examples/mnist/cluster.conf [-procsID 0] [-hostfile h]
+
+Serving (the inference tier, singa_tpu/serve/):
+    python -m singa_tpu.main serve -model_conf lm.conf \
+        --workspace ws [--port 8000] [--serve_spec 'buckets=4x16/8x32,...']
+follows the trainer's checkpoints in the workspace (hot-reload) and
+serves /generate, /predict, /stats, /healthz over stdlib HTTP.
 """
 
 from __future__ import annotations
@@ -91,7 +97,119 @@ def make_argparser() -> argparse.ArgumentParser:
     return ap
 
 
+def make_serve_argparser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="singa_tpu serve",
+        description="TPU-native inference serving tier "
+                    "(docs/SERVING.md): micro-batched request "
+                    "scheduler over compiled bucket programs, with "
+                    "checkpoint hot-reload")
+    ap.add_argument("-model_conf", "--model_conf", required=True)
+    ap.add_argument("--workspace", default=None,
+                    help="checkpoint workspace to serve from and "
+                         "hot-reload against (the trainer's "
+                         "--workspace); omit to serve fresh-init "
+                         "params (smoke/dev only)")
+    ap.add_argument("--serve_spec", default=None,
+                    help="serving config: comma-separated key=value "
+                         "over the ServeSpec fields, buckets as "
+                         "BxP '/' entries, e.g. 'buckets=1x16/4x32,"
+                         "max_new_tokens=32,eos_id=2,"
+                         "batch_window_s=0.005' "
+                         "(singa_tpu/serve/engine.py)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8000,
+                    help="HTTP port (0 = ephemeral)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--smoke", type=int, default=0, metavar="N",
+                    help="serve N synthetic in-process requests, "
+                         "print the stats snapshot as JSON, and exit "
+                         "(no HTTP listener)")
+    ap.add_argument("--fault_spec", default=None,
+                    help="deterministic fault injection over the "
+                         "serve.* sites (singa_tpu/utils/faults.py)")
+    return ap
+
+
+def serve_main(argv) -> int:
+    """The `serve` subcommand: build the inference net from the model
+    config, load the latest healthy checkpoint, and serve."""
+    import json as _json
+
+    args = make_serve_argparser().parse_args(argv)
+    from .utils.faults import FaultSchedule, inject
+    schedule = (FaultSchedule.parse(args.fault_spec, seed=args.seed)
+                if args.fault_spec else None)
+
+    model = load_model_config(args.model_conf)
+    from .data import discover_input_shapes
+    input_shapes = discover_input_shapes(model, force_synthetic=True)
+    trainer = Trainer(model, input_shapes, log_fn=lambda s: None)
+    # the inference net: test phase when the config defines one, else
+    # the train net (same params either way)
+    net = trainer.test_net or trainer.train_net
+
+    import jax
+
+    from .serve import InferenceEngine, InferenceServer, ServeSpec
+    spec = (ServeSpec.parse(args.serve_spec) if args.serve_spec
+            else ServeSpec())
+    # fresh-init fallback so a checkpoint-less workspace still serves
+    # (engine.load prefers any restorable healthy snapshot)
+    fallback = net.init_params(jax.random.PRNGKey(args.seed))
+    engine = InferenceEngine(net, spec, workspace=args.workspace,
+                             params=fallback, log_fn=print)
+
+    with inject(schedule):
+        if schedule is not None:
+            print(f"fault injection active: {args.fault_spec} "
+                  f"(seed {args.seed})")
+        server = InferenceServer(engine, host=args.host,
+                                 port=args.port,
+                                 http=(args.smoke == 0), log_fn=print)
+        server.start()
+        if engine.params_step < 0:
+            print("warning: serving fresh-init params (no restorable "
+                  "checkpoint in the workspace)", file=sys.stderr)
+        try:
+            if args.smoke > 0:
+                import numpy as np
+                rng = np.random.default_rng(args.seed)
+                vocab = _serve_vocab(net)
+                for i in range(args.smoke):
+                    plen = int(rng.integers(1, spec.max_prompt_len + 1))
+                    prompt = rng.integers(0, vocab, plen).astype("int32")
+                    out = server.generate(prompt)
+                    print(f"smoke {i}: plen={plen} -> "
+                          f"{len(out['tokens'])} tokens "
+                          f"(step {out['step']}, "
+                          f"bucket {out['bucket']})")
+                print(_json.dumps(server.snapshot()))
+                return 0
+            import time
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            print("\nserve: shutting down")
+            print(_json.dumps(server.snapshot()))
+            return 0
+        finally:
+            server.stop()
+
+
+def _serve_vocab(net) -> int:
+    for layer in net.layers.values():
+        for attr in ("vocab_size", "vocab"):
+            v = getattr(layer, attr, None)
+            if isinstance(v, int) and v > 1:
+                return v
+    return 256
+
+
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "serve":
+        return serve_main(argv[1:])
     args = make_argparser().parse_args(argv)
     from .utils.faults import FaultSchedule, inject
     schedule = (FaultSchedule.parse(args.fault_spec, seed=args.seed)

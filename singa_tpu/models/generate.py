@@ -51,10 +51,18 @@ def init_cache(net: NeuralNet, batchsize: int, max_len: int,
     return cache
 
 
-def _attn_cached(layer, params, x, entry: CacheEntry, pos
+def _attn_cached(layer, params, x, entry: CacheEntry, pos,
+                 kmask: Optional[jnp.ndarray] = None
                  ) -> Tuple[jnp.ndarray, CacheEntry]:
     """Attention for a (B, T, E) chunk whose first token sits at absolute
     position `pos` (traced scalar), against the running KV cache.
+
+    `kmask` (B, max_len) bool, optional: per-sequence validity of key
+    positions, ANDed with the causal mask.  The serving tier LEFT-pads
+    variable-length prompts to a bucket length and masks the pad keys —
+    with RoPE's relative rotations, left-padding keeps every attended
+    (query, key) distance identical to the unpadded sequence, so a
+    padded batched decode matches the unpadded one.
 
     GQA reads the cache at Hkv width: q is grouped to (B, Hkv, G, T, D)
     and contracted against the (B, Hkv, max_len, D) cache directly — no
@@ -75,11 +83,14 @@ def _attn_cached(layer, params, x, entry: CacheEntry, pos
     vv = v_cache.astype(q.dtype)
     qpos = pos + jnp.arange(t)[:, None]            # (T, 1) absolute
     kpos = jnp.arange(kk.shape[2])[None, :]        # (1, max_len)
+    allowed = (kpos <= qpos)[None]                 # (1, T, max_len)
+    if kmask is not None:
+        allowed = allowed & kmask[:, None, :]      # (B, T, max_len)
     if groups == 1:
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, kk,
                             preferred_element_type=jnp.float32)
         scores = scores / jnp.sqrt(jnp.float32(layer.head_dim))
-        scores = jnp.where((kpos <= qpos)[None, None], scores, -1e30)
+        scores = jnp.where(allowed[:, None], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vv.dtype), vv)
     else:
@@ -87,8 +98,7 @@ def _attn_cached(layer, params, x, entry: CacheEntry, pos
         scores = jnp.einsum("bhgqd,bhkd->bhgqk", qg, kk,
                             preferred_element_type=jnp.float32)
         scores = scores / jnp.sqrt(jnp.float32(layer.head_dim))
-        scores = jnp.where((kpos <= qpos)[None, None, None], scores,
-                           -1e30)
+        scores = jnp.where(allowed[:, None, None], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum("bhgqk,bhkd->bhgqd", probs.astype(vv.dtype), vv)
         out = out.reshape(b, layer.heads, t, layer.head_dim)
@@ -102,9 +112,14 @@ _CTX = Context(batch={}, train=False, rng=None, layer_index=0, mesh=None,
 
 
 def forward_cached(net: NeuralNet, params, tokens: jnp.ndarray,
-                   cache: Cache, pos) -> Tuple[jnp.ndarray, Cache]:
+                   cache: Cache, pos,
+                   kmask: Optional[jnp.ndarray] = None
+                   ) -> Tuple[jnp.ndarray, Cache]:
     """Run the LM over a (B, T) token chunk at absolute offset `pos`.
-    Returns (logits (B, T, V) float32, updated cache)."""
+    Returns (logits (B, T, V) float32, updated cache).  `kmask`
+    (B, max_len) bool marks per-sequence attendable key positions
+    (see `_attn_cached` — the serving tier's left-pad mask); None
+    keeps the pure causal mask."""
     full = net._resolve_params(params)
     outputs: Dict[str, Any] = {}
     new_cache: Cache = dict(cache)
@@ -119,7 +134,7 @@ def forward_cached(net: NeuralNet, params, tokens: jnp.ndarray,
             outputs[name] = tokens
         elif ltype == "kAttention":
             out, new_cache[name] = _attn_cached(
-                layer, full, srcs[0], cache[name], pos)
+                layer, full, srcs[0], cache[name], pos, kmask=kmask)
             outputs[name] = out
         elif ltype == "kLMHead":
             outputs[name] = layer.apply(full, srcs, _CTX)
@@ -213,6 +228,11 @@ def _beam_jit(net, params, prompt, max_new_tokens, num_beams,
     logits, cache = forward_cached(net, params, prompt, cache, 0)
     lp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
     vocab = lp0.shape[-1]
+    if eos_id is not None and not 0 <= eos_id < vocab:
+        # out of range, the frozen-vector .at[eos_id].set() below would
+        # silently drop and beam freezing would never engage
+        raise ValueError(f"eos_id={eos_id} out of range for vocab size "
+                         f"{vocab}")
     # only min(W, V) distinct beams exist after one token; pad the rest
     # with -inf scores so they never outrank a real candidate
     k0 = min(w, vocab)
@@ -295,6 +315,10 @@ def beam_search(net: NeuralNet, params, prompt, max_new_tokens: int,
     keep one compiled cache geometry across runs of different
     lengths."""
     prompt = jnp.asarray(prompt, jnp.int32)
+    if int(num_beams) < 1:
+        # num_beams=0 would reach jax.lax.top_k(lp0, 0) and die with a
+        # cryptic XLA error deep in the trace
+        raise ValueError(f"num_beams must be >= 1, got {num_beams}")
     if int(max_new_tokens) <= 0:
         b = prompt.shape[0]
         return (jnp.zeros((b, 0), jnp.int32), jnp.zeros((b,), jnp.float32))

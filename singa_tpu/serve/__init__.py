@@ -1,0 +1,29 @@
+"""TPU-native inference serving tier (docs/SERVING.md).
+
+The first subsystem on the inference half of the north star: admit ->
+micro-batch -> compiled bucket program -> respond, following the
+trainer's checkpoints via atomic hot-reload.  Layers:
+
+    engine.py   ServeSpec + InferenceEngine: AOT-compiled per-bucket
+                generate/predict programs, healthy-checkpoint load,
+                degrade-not-crash hot reload
+    batcher.py  MicroBatcher: bounded-queue admission with Backoff
+                shedding, deadline expiry, smallest-admissible-bucket
+                coalescing with left-pad masking
+    server.py   InferenceServer: stdlib-HTTP + in-process frontends,
+                reload poll thread
+    stats.py    ServeStats: QPS, p50/p95 latency, occupancy, queue
+                depth, reload/shed counters (PipelineStats mold)
+
+Fault sites `serve.admit` / `serve.batch` / `serve.reload`
+(utils.faults) make every degradation path deterministic on CPU.
+"""
+
+from .batcher import DeadlineExpired, MicroBatcher, Overloaded, Ticket
+from .engine import InferenceEngine, ServeSpec
+from .server import InferenceServer
+from .stats import ServeStats
+
+__all__ = ["DeadlineExpired", "InferenceEngine", "InferenceServer",
+           "MicroBatcher", "Overloaded", "ServeSpec", "ServeStats",
+           "Ticket"]

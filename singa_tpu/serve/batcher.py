@@ -1,0 +1,294 @@
+"""Deadline-aware micro-batcher: coalesce queued requests into the
+smallest admissible compiled bucket.
+
+Admission (`submit`) is bounded-queue with `Backoff`-based shedding:
+a full queue (or an injected `serve.admit` fault) raises `Overloaded`
+carrying a `retry_after` hint that grows exponentially with
+consecutive sheds — callers that honor it decongest the queue instead
+of hammering it.  Admitted requests get a `Ticket` (a tiny future);
+`Ticket.wait()` returns the result dict or raises the failure.
+
+The dispatch loop gathers the queue head, waits at most
+`batch_window_s` for co-batchable arrivals (early-out when the widest
+bucket fills), drops requests whose deadline passed while queued
+(counted `expired`, failed with `DeadlineExpired`), picks
+`spec.bucket_for(n, max_plen)` and LEFT-pads every prompt to the
+bucket length (`plens` carries the real lengths for the engine's
+kmask).  Overflow beyond the bucket's batch goes back to the queue
+head.  Pad rows are dummy single-pad-token prompts — they decode
+garbage nobody reads; occupancy (real/slots) is the stat that prices
+them.
+
+Fault sites: `serve.admit` (shed one request), `serve.batch` (fail
+one dispatched batch's requests — the loop and the server stay up).
+Params atomicity: the loop reads `engine.params` ONCE per batch and
+passes it to `run_batch`, so a hot-reload swap mid-dispatch cannot
+tear a batch (see engine.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import faults
+from .engine import InferenceEngine
+from .stats import ServeStats
+
+
+class Overloaded(RuntimeError):
+    """Admission rejected; retry after `retry_after` seconds."""
+
+    def __init__(self, msg: str, retry_after: float = 0.0):
+        super().__init__(msg)
+        self.retry_after = retry_after
+
+
+class DeadlineExpired(RuntimeError):
+    """The request's deadline passed before it was dispatched."""
+
+
+class Ticket:
+    """One request's future: wait() blocks until the dispatch loop
+    resolves or fails it."""
+
+    def __init__(self):
+        self._done = threading.Event()
+        self._result: Optional[Dict[str, Any]] = None
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def _resolve(self, result: Dict[str, Any]) -> None:
+        self._result = result
+        self._done.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        self._error = exc
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        if not self._done.wait(timeout):
+            raise TimeoutError("request still queued/running")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+@dataclass
+class _Request:
+    tokens: np.ndarray            # (plen,) int32
+    plen: int
+    mode: str
+    ticket: Ticket
+    t_submit: float
+    deadline: Optional[float]     # monotonic, None = no deadline
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+class MicroBatcher:
+    """See module docstring.  One daemon dispatch thread; `submit` is
+    called from any number of frontend threads."""
+
+    def __init__(self, engine: InferenceEngine,
+                 stats: Optional[ServeStats] = None, log_fn=print,
+                 backoff: Optional[faults.Backoff] = None):
+        self.engine = engine
+        self.spec = engine.spec
+        self.stats = stats if stats is not None else engine.stats
+        self.log = log_fn
+        self._backoff = backoff if backoff is not None else \
+            faults.Backoff(base=0.05, cap=2.0, seed=self.spec.seed)
+        self._q: deque = deque()
+        self._cv = threading.Condition()
+        self._sheds_in_a_row = 0
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "MicroBatcher":
+        if self._thread is not None:
+            return self
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop,
+                                        name="serve-dispatch",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        # fail anything still queued so no client blocks forever
+        with self._cv:
+            leftovers = list(self._q)
+            self._q.clear()
+            self.stats.gauge("queue_depth", 0)
+        for r in leftovers:
+            self.stats.count("failed")
+            r.ticket._fail(RuntimeError("server shutting down"))
+
+    # -- admission ----------------------------------------------------------
+    def submit(self, tokens, mode: str = "generate",
+               timeout: Optional[float] = None) -> Ticket:
+        """Admit one request.  `tokens` is a 1-D int32 prompt;
+        `timeout` (seconds, default spec.request_timeout_s; <=0 = no
+        deadline) bounds time-in-queue.  Raises `Overloaded` (with
+        `retry_after`) when the queue is full or a `serve.admit` fault
+        fires; ValueError for an unservable prompt."""
+        arr = np.asarray(tokens, np.int32).reshape(-1)
+        if arr.size < 1:
+            raise ValueError("empty prompt")
+        if arr.size > self.spec.max_prompt_len:
+            raise ValueError(
+                f"prompt length {arr.size} exceeds the largest bucket "
+                f"({self.spec.max_prompt_len}); not servable")
+        if mode not in ("generate", "predict"):
+            raise ValueError(f"unknown mode {mode!r}")
+        if timeout is None:
+            timeout = self.spec.request_timeout_s
+        now = time.monotonic()
+        req = _Request(tokens=arr, plen=int(arr.size), mode=mode,
+                       ticket=Ticket(), t_submit=now,
+                       deadline=(now + timeout) if timeout > 0 else None)
+        try:
+            faults.maybe_fault("serve.admit")
+        except faults.FaultError as e:
+            return self._shed(f"admission fault: {e}")
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("batcher is stopped")
+            if len(self._q) >= self.spec.queue_capacity:
+                pass  # shed outside the lock's happy path below
+            else:
+                self._q.append(req)
+                self._sheds_in_a_row = 0
+                self.stats.count("submitted")
+                self.stats.gauge("queue_depth", len(self._q))
+                self._cv.notify()
+                return req.ticket
+        return self._shed(
+            f"queue full ({self.spec.queue_capacity} requests)")
+
+    def _shed(self, why: str) -> "Ticket":
+        with self._cv:
+            self._sheds_in_a_row += 1
+            attempt = self._sheds_in_a_row
+        self.stats.count("shed")
+        retry = self._backoff.delay(attempt - 1)
+        raise Overloaded(f"request shed ({why}); retry after "
+                         f"{retry:.3f}s", retry_after=retry)
+
+    # -- dispatch loop ------------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            gathered = self._gather()
+            if gathered is None:
+                if self._stop:
+                    return
+                continue
+            reqs, bucket = gathered
+            self._dispatch(reqs, bucket)
+
+    def _gather(self) -> Optional[Tuple[List[_Request],
+                                        Tuple[int, int]]]:
+        """Block for work, coalesce within the batch window, expire
+        stale requests, choose a bucket, and push overflow back."""
+        spec = self.spec
+        with self._cv:
+            while not self._q and not self._stop:
+                self._cv.wait(0.1)
+            if not self._q:
+                return None
+            t_end = time.monotonic() + spec.batch_window_s
+            while len(self._q) < spec.max_batch and not self._stop:
+                rem = t_end - time.monotonic()
+                if rem <= 0:
+                    break
+                self._cv.wait(rem)
+            # take same-mode requests from the head; different-mode
+            # ones go back to the head (they lead the next gather)
+            mode = self._q[0].mode
+            reqs: List[_Request] = []
+            defer: List[_Request] = []
+            now = time.monotonic()
+            while self._q and len(reqs) < spec.max_batch:
+                r = self._q.popleft()
+                if r.deadline is not None and now > r.deadline:
+                    self.stats.count("expired")
+                    r.ticket._fail(DeadlineExpired(
+                        f"deadline passed after "
+                        f"{now - r.t_submit:.3f}s in queue"))
+                    continue
+                if r.mode != mode:
+                    defer.append(r)
+                    continue
+                reqs.append(r)
+            if not reqs:
+                self._q.extendleft(reversed(defer))
+                self.stats.gauge("queue_depth", len(self._q))
+                return None
+            bucket = spec.bucket_for(len(reqs),
+                                     max(r.plen for r in reqs))
+            if len(reqs) > bucket[0]:
+                defer = reqs[bucket[0]:] + defer
+                reqs = reqs[:bucket[0]]
+            self._q.extendleft(reversed(defer))
+            self.stats.gauge("queue_depth", len(self._q))
+        return reqs, bucket
+
+    def _dispatch(self, reqs: List[_Request],
+                  bucket: Tuple[int, int]) -> None:
+        b, p = bucket
+        try:
+            faults.maybe_fault("serve.batch")
+            # ONE read of the live tree: a concurrent hot-reload swap
+            # cannot change params under this batch
+            params = self.engine.params
+            step = self.engine.params_step
+            tokens = np.full((b, p), self.spec.pad_id, np.int32)
+            plens = np.ones((b,), np.int32)   # pad rows: 1-token dummy
+            for i, r in enumerate(reqs):
+                tokens[i, p - r.plen:] = r.tokens
+                plens[i] = r.plen
+            mode = reqs[0].mode
+            out = self.engine.run_batch(mode, tokens, plens,
+                                        params=params)
+        except Exception as e:  # noqa: BLE001 — fail batch, keep serving
+            self.stats.count("failed", len(reqs))
+            self.log(f"warning: serve batch failed "
+                     f"({type(e).__name__}: {e}); {len(reqs)} "
+                     f"request(s) failed, server continues")
+            for r in reqs:
+                r.ticket._fail(e if isinstance(e, faults.FaultError)
+                               else RuntimeError(f"batch failed: {e}"))
+            return
+        self.stats.observe_batch(len(reqs), b)
+        now = time.monotonic()
+        for i, r in enumerate(reqs):
+            if r.mode == "generate":
+                toks = self._trim_eos(out[i])
+                result = {"tokens": toks, "step": step,
+                          "bucket": [b, p]}
+            else:
+                result = {"logprobs": out[i].tolist(), "step": step,
+                          "bucket": [b, p]}
+            self.stats.observe_latency(now - r.t_submit)
+            r.ticket._resolve(result)
+
+    def _trim_eos(self, row: np.ndarray) -> List[int]:
+        eos = self.spec.eos_id
+        toks = row.tolist()
+        if eos is None or eos not in toks:
+            return toks
+        return toks[:toks.index(eos) + 1]

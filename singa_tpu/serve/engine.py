@@ -1,0 +1,393 @@
+"""Inference engine: compiled-per-bucket decode programs over the
+latest *healthy* checkpoint, with atomic hot-reload.
+
+The serving hot path must never trace ("RPC Considered Harmful" — keep
+per-request overhead off the device path): the engine AOT-compiles one
+generate and/or predict executable per (batch, prompt_len) shape
+bucket (`jax.jit(...).lower(...).compile()`), and thereafter only ever
+invokes Compiled executables — a hard guarantee of zero recompiles,
+made observable through `ServeStats.compiles` (incremented ONLY inside
+`_compile`, so a warmed server must hold the counter constant).
+
+Variable-length prompts are LEFT-padded to the bucket length with a
+per-key validity mask (see `_attn_cached`'s `kmask`): RoPE rotations
+are relative, so left-padding preserves every attended (query, key)
+distance, the last real prompt token sits at a uniform position P-1
+across the batch, and masked pad keys contribute exactly zero after
+softmax.  Padded batched decode therefore matches unpadded decode
+bit-for-bit in f32.
+
+Hot reload (`poll_reload`) is cheap-poll + atomic-swap: compare
+`CheckpointManager.fingerprint()` (two stats, no reads); on change,
+`restore(skip_unhealthy=True)` walks back past numerically suspect
+snapshots, the new params are placed on device and swapped in with a
+single attribute assignment.  Dispatchers read `engine.params` once
+per micro-batch, so in-flight batches finish on the params they
+started with — a reload never drops a request.  Every degradation is
+a counted non-event: a failed restore keeps the old params live
+(`reload_failures`, fingerprint unchanged so the next poll retries);
+a walk-back that lands on the already-served step is `reloads_refused`
+(fingerprint recorded so it is not re-attempted every poll).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.generate import _sample, forward_cached, init_cache
+from ..utils import faults
+from ..utils.checkpoint import CheckpointManager
+from .stats import ServeStats
+
+MODES = ("generate", "predict")
+
+
+@dataclass(frozen=True)
+class ServeSpec:
+    """Serving configuration.  `buckets` is the closed set of compiled
+    (batch, prompt_len) shapes — every request is padded into one of
+    them, so after `warmup()` no program is ever compiled again.
+    `bucket_for` picks the smallest admissible bucket: fewest padded
+    slots first, then shortest prompt padding."""
+    buckets: Tuple[Tuple[int, int], ...] = ((1, 16), (4, 16), (8, 32))
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 0.0
+    eos_id: Optional[int] = None
+    pad_id: int = 0
+    queue_capacity: int = 64
+    batch_window_s: float = 0.01
+    request_timeout_s: float = 5.0
+    reload_poll_s: float = 1.0
+    seed: int = 0
+
+    def __post_init__(self):
+        norm = []
+        for b in self.buckets:
+            bb, pp = int(b[0]), int(b[1])
+            if bb < 1 or pp < 1:
+                raise ValueError(f"bad bucket {b!r}: batch and "
+                                 f"prompt_len must be >= 1")
+            norm.append((bb, pp))
+        if not norm:
+            raise ValueError("ServeSpec needs at least one bucket")
+        object.__setattr__(self, "buckets",
+                           tuple(sorted(set(norm),
+                                        key=lambda c: (c[1], c[0]))))
+        if int(self.max_new_tokens) < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got "
+                             f"{self.max_new_tokens}")
+        if int(self.queue_capacity) < 1:
+            raise ValueError(f"queue_capacity must be >= 1, got "
+                             f"{self.queue_capacity}")
+
+    @property
+    def max_prompt_len(self) -> int:
+        return max(p for _, p in self.buckets)
+
+    @property
+    def max_batch(self) -> int:
+        return max(b for b, _ in self.buckets)
+
+    def bucket_for(self, n: int, prompt_len: int) -> Tuple[int, int]:
+        """Smallest admissible bucket for `n` requests whose longest
+        prompt is `prompt_len`.  When no bucket holds all `n`, the
+        widest admissible one is returned (the caller dispatches a full
+        batch and re-queues the overflow)."""
+        cands = [c for c in self.buckets if c[1] >= prompt_len]
+        if not cands:
+            raise ValueError(
+                f"prompt_len={prompt_len} exceeds every bucket "
+                f"{self.buckets}; admission should have rejected it")
+        fit = [c for c in cands if c[0] >= n]
+        if fit:
+            return min(fit, key=lambda c: (c[0], c[1]))
+        return min(cands, key=lambda c: (-c[0], c[1]))
+
+    @classmethod
+    def parse(cls, spec: str) -> "ServeSpec":
+        """CLI grammar (HealthSpec mold): comma/semicolon-separated
+        `key=value`.  Buckets are `/`-separated BxP entries, e.g.
+        `"buckets=1x8/4x16,max_new_tokens=8,eos_id=2"`.  `eos_id=none`
+        clears the eos."""
+        kw: Dict[str, Any] = {}
+        types = {f.name: f.type for f in dataclasses.fields(cls)}
+        for part in spec.replace(";", ",").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            try:
+                key, _, val = part.partition("=")
+                key, val = key.strip(), val.strip()
+                if key not in types:
+                    raise ValueError(f"unknown key {key!r}")
+                if key == "buckets":
+                    kw[key] = tuple(
+                        tuple(int(x) for x in item.lower().split("x"))
+                        for item in val.split("/") if item)
+                elif key == "eos_id":
+                    kw[key] = None if val.lower() in ("none", "") \
+                        else int(val)
+                elif "float" in str(types[key]):
+                    kw[key] = float(val)
+                else:
+                    kw[key] = int(val)
+            except ValueError as e:
+                raise ValueError(f"bad serve spec entry {part!r} "
+                                 f"(want key=value): {e}") from e
+        return cls(**kw)
+
+
+def _left_pad_mask(prompt_len: int, max_len: int,
+                   plens: jnp.ndarray) -> jnp.ndarray:
+    """(B, max_len) bool: key position j of row i is attendable iff
+    j >= prompt_len - plens[i].  Prompt tokens occupy the RIGHT end of
+    the padded prompt region; every generated position (>= prompt_len)
+    is attendable for all rows."""
+    kpos = jnp.arange(max_len)[None, :]
+    return kpos >= (prompt_len - plens)[:, None]
+
+
+def _tree_spec(tree):
+    return jax.tree_util.tree_map(
+        lambda a: (tuple(a.shape), str(jnp.asarray(a).dtype)), tree)
+
+
+class InferenceEngine:
+    """Loads params from the latest healthy checkpoint, compiles one
+    executable per (mode, batch, prompt_len) bucket, runs padded
+    micro-batches, and hot-reloads checkpoints without dropping
+    in-flight work.  See the module docstring for the swap/degrade
+    contract.  Thread-safe: `_compile` is serialized; `run_batch`
+    callers pass the params they captured."""
+
+    def __init__(self, net, spec: ServeSpec,
+                 workspace: Optional[str] = None,
+                 params: Optional[Dict[str, Any]] = None,
+                 stats: Optional[ServeStats] = None, log_fn=print):
+        if workspace is None and params is None:
+            raise ValueError("InferenceEngine needs a checkpoint "
+                             "workspace or explicit params")
+        self.net = net
+        self.spec = spec
+        self.stats = stats if stats is not None else ServeStats()
+        self.log = log_fn
+        self.ckpt = (CheckpointManager(workspace, log_fn=log_fn)
+                     if workspace is not None else None)
+        self._params = (jax.device_put(params)
+                        if params is not None else None)
+        self.params_step: int = -1
+        self._fingerprint: Optional[tuple] = None
+        self._compiled: Dict[Tuple[str, int, int], Any] = {}
+        self._compile_lock = threading.Lock()
+        self._key_counter = 0
+        self._key_lock = threading.Lock()
+
+    # -- params lifecycle ---------------------------------------------------
+    @property
+    def params(self):
+        """The live params tree.  Read ONCE per micro-batch and pass to
+        `run_batch` — that single read is what makes the hot-reload
+        swap atomic with respect to in-flight work."""
+        return self._params
+
+    def _swap(self, params, step: int) -> None:
+        new = jax.device_put(params)
+        if self._params is not None and \
+                _tree_spec(new) != _tree_spec(self._params):
+            raise RuntimeError(
+                f"checkpoint step {step} has a different parameter "
+                f"geometry than the serving model; refusing the swap")
+        self._params = new            # atomic: one attribute store
+        self.params_step = step
+
+    def load(self) -> int:
+        """Initial load: latest healthy checkpoint (walks back past
+        unhealthy/corrupt snapshots).  Falls back to constructor params
+        when the workspace has nothing restorable.  Returns the served
+        step (-1 = constructor params)."""
+        if self.ckpt is not None:
+            restored = self.ckpt.restore(skip_unhealthy=True)
+            self._fingerprint = self.ckpt.fingerprint()
+            if restored is not None:
+                p, _, step = restored
+                self._swap(p, step)
+            elif self._params is None:
+                raise RuntimeError(
+                    f"no restorable healthy checkpoint under "
+                    f"{self.ckpt.dir} and no fallback params")
+        return self.params_step
+
+    def poll_reload(self) -> str:
+        """One hot-reload attempt; returns "reloaded" | "unchanged" |
+        "refused" | "failed".  Never raises and never unseats the live
+        params on failure — the degrade contract the server's poll
+        thread relies on (the process stays up, old params keep
+        serving)."""
+        if self.ckpt is None:
+            return "unchanged"
+        try:
+            faults.maybe_fault("serve.reload")
+            fp = self.ckpt.fingerprint()
+            if fp == self._fingerprint:
+                return "unchanged"
+            restored = self.ckpt.restore(skip_unhealthy=True)
+            if restored is None or restored[2] == self.params_step:
+                # nothing newer that is healthy (the walk-back landed on
+                # what we already serve, or on nothing).  Record the
+                # fingerprint so the refusal is not re-litigated every
+                # poll tick; a future save changes it again.
+                self._fingerprint = fp
+                self.stats.count("reloads_refused")
+                self.log("serve: reload refused — no newer healthy "
+                         f"checkpoint (serving step {self.params_step})")
+                return "refused"
+            p, _, step = restored
+            self._swap(p, step)
+            self._fingerprint = fp
+            self.stats.count("reloads")
+            self.log(f"serve: hot-reloaded checkpoint step {step}")
+            return "reloaded"
+        except Exception as e:  # noqa: BLE001 — degrade, never crash
+            # fingerprint deliberately NOT updated: the next poll
+            # retries the same reload instead of wedging on old params
+            self.stats.count("reload_failures")
+            self.log(f"warning: serve reload failed "
+                     f"({type(e).__name__}: {e}); keeping params from "
+                     f"step {self.params_step}")
+            return "failed"
+
+    # -- compiled programs --------------------------------------------------
+    def _build_generate(self, batch: int, prompt_len: int):
+        net, spec = self.net, self.spec
+        max_new = int(spec.max_new_tokens)
+        max_len = prompt_len + max_new
+        temperature, top_k, top_p = (float(spec.temperature),
+                                     int(spec.top_k), float(spec.top_p))
+        eos_id = spec.eos_id
+
+        def fn(params, tokens, plens, key):
+            dtype = jax.tree_util.tree_leaves(params)[0].dtype
+            cache = init_cache(net, batch, max_len, dtype)
+            kmask = _left_pad_mask(prompt_len, max_len, plens)
+            logits, cache = forward_cached(net, params, tokens, cache,
+                                           0, kmask=kmask)
+            keys = jax.random.split(key, max_new)
+            tok0 = _sample(logits[:, -1], keys[0], temperature, top_k,
+                           top_p)
+            done0 = (jnp.zeros((batch,), jnp.bool_) if eos_id is None
+                     else tok0 == eos_id)
+
+            def step(carry, k):
+                tok, cache, pos, done = carry
+                lg, cache = forward_cached(net, params, tok[:, None],
+                                           cache, pos, kmask=kmask)
+                nxt = _sample(lg[:, -1], k, temperature, top_k, top_p)
+                if eos_id is not None:
+                    nxt = jnp.where(done, eos_id, nxt)
+                    done = done | (nxt == eos_id)
+                return (nxt, cache, pos + 1, done), nxt
+
+            (_, _, _, _), rest = jax.lax.scan(
+                step, (tok0, cache, jnp.int32(prompt_len), done0),
+                keys[1:])
+            return jnp.concatenate([tok0[:, None], rest.T], axis=1)
+
+        return fn
+
+    def _build_predict(self, batch: int, prompt_len: int):
+        net, spec = self.net, self.spec
+        max_len = prompt_len + 1
+
+        def fn(params, tokens, plens):
+            dtype = jax.tree_util.tree_leaves(params)[0].dtype
+            cache = init_cache(net, batch, max_len, dtype)
+            kmask = _left_pad_mask(prompt_len, max_len, plens)
+            logits, _ = forward_cached(net, params, tokens, cache, 0,
+                                       kmask=kmask)
+            # left-padding puts every row's last real token at P-1, so
+            # one static slice reads the next-token distribution
+            return jax.nn.log_softmax(
+                logits[:, -1].astype(jnp.float32), axis=-1)
+
+        return fn
+
+    def _compile(self, mode: str, batch: int, prompt_len: int):
+        key = (mode, batch, prompt_len)
+        got = self._compiled.get(key)
+        if got is not None:
+            return got
+        with self._compile_lock:
+            got = self._compiled.get(key)
+            if got is not None:
+                return got
+            if self._params is None:
+                raise RuntimeError("engine has no params; call load()")
+            if mode not in MODES:
+                raise ValueError(f"unknown mode {mode!r}; modes are "
+                                 f"{MODES}")
+            p_spec = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                self._params)
+            tok = jax.ShapeDtypeStruct((batch, prompt_len), jnp.int32)
+            pl = jax.ShapeDtypeStruct((batch,), jnp.int32)
+            if mode == "generate":
+                fn = self._build_generate(batch, prompt_len)
+                rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+                compiled = jax.jit(fn).lower(p_spec, tok, pl,
+                                             rng).compile()
+            else:
+                fn = self._build_predict(batch, prompt_len)
+                compiled = jax.jit(fn).lower(p_spec, tok, pl).compile()
+            self.stats.count("compiles")
+            self._compiled[key] = compiled
+            return compiled
+
+    def warmup(self, modes=("generate",)) -> int:
+        """Compile every (mode, bucket) executable up front.  Returns
+        the number of compiles performed; after this, steady-state
+        serving never compiles again (stats.compiles stays put)."""
+        before = self.stats.compiles
+        for mode in modes:
+            for b, p in self.spec.buckets:
+                self._compile(mode, b, p)
+        return self.stats.compiles - before
+
+    # -- execution ----------------------------------------------------------
+    def _next_key(self) -> np.ndarray:
+        # raw threefry key data, built host-side: no jax dispatch (and
+        # no trace) on the per-batch path
+        with self._key_lock:
+            n = self.spec.seed * 1000003 + self._key_counter
+            self._key_counter += 1
+        return np.array([(n >> 32) & 0xFFFFFFFF, n & 0xFFFFFFFF],
+                        np.uint32)
+
+    def run_batch(self, mode: str, tokens: np.ndarray,
+                  plens: np.ndarray, params=None) -> np.ndarray:
+        """Run one padded micro-batch through the bucket's compiled
+        executable.  `tokens` (B, P) int32 LEFT-padded with
+        spec.pad_id, `plens` (B,) int32 real prompt lengths.  `params`
+        is the tree the dispatcher captured from `self.params` (falls
+        back to the live tree for direct callers).  Returns (B,
+        max_new_tokens) int32 for generate, (B, V) float32 next-token
+        log-probs for predict."""
+        if params is None:
+            params = self._params
+        b, p = tokens.shape
+        compiled = self._compile(mode, b, p)
+        tokens = jnp.asarray(tokens, jnp.int32)
+        plens = jnp.asarray(plens, jnp.int32)
+        if mode == "generate":
+            out = compiled(params, tokens, plens, self._next_key())
+        else:
+            out = compiled(params, tokens, plens)
+        return np.asarray(out)

@@ -1,0 +1,207 @@
+"""Threaded serving frontend: stdlib HTTP plus an in-process client
+API over the same engine + batcher.
+
+The HTTP layer is deliberately thin — the transport never touches the
+hot path ("RPC Considered Harmful"): a handler thread only parses
+JSON, calls `MicroBatcher.submit`, and parks on the request's
+`Ticket`; all device work happens on the single dispatch thread
+through compiled bucket programs.  In-process callers
+(`InferenceServer.generate` / `.predict`, used by tests and the bench
+smoke) take the same submit/wait path, so both frontends share one
+admission-control, batching, and stats story.
+
+Endpoints:
+    POST /generate  {"tokens": [ints], "timeout": s?}   -> {"tokens",
+                    "step", "bucket", "latency_ms"}
+    POST /predict   {"tokens": [ints], "timeout": s?}   -> {"logprobs",
+                    "step", "bucket", "latency_ms"}
+    GET  /stats     ServeStats.snapshot() incl. served params step
+    GET  /healthz   {"ok": true, "step": n}
+Status mapping: 503 + Retry-After on `Overloaded` (shed), 504 on
+deadline/timeout, 400 on a malformed request, 500 on a failed batch.
+
+A daemon poll thread calls `engine.poll_reload()` every
+`spec.reload_poll_s` — hot reloads (and their counted degradations)
+happen without any frontend involvement.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .batcher import DeadlineExpired, MicroBatcher, Overloaded
+from .engine import InferenceEngine, ServeSpec  # noqa: F401 (re-export)
+from .stats import ServeStats  # noqa: F401 (re-export: stats mold)
+
+
+class InferenceServer:
+    """Owns the engine, the batcher, the reload poll thread, and
+    (optionally) the HTTP frontend.  `start()` loads + warms the
+    engine and spins everything up; `stop()` tears it down in reverse
+    order.  Usable as a context manager."""
+
+    def __init__(self, engine: InferenceEngine,
+                 host: str = "127.0.0.1", port: int = 0,
+                 http: bool = True, warmup_modes=("generate",),
+                 log_fn=print):
+        self.engine = engine
+        self.stats = engine.stats
+        self.batcher = MicroBatcher(engine, log_fn=log_fn)
+        self.log = log_fn
+        self._host, self._port = host, port
+        self._http_wanted = http
+        self._warmup_modes = tuple(warmup_modes)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+        self._poll_stop = threading.Event()
+        self._poll_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "InferenceServer":
+        if self.engine.params is None:
+            self.engine.load()
+        n = self.engine.warmup(self._warmup_modes)
+        self.log(f"serve: warmed {n} program(s) for buckets "
+                 f"{self.engine.spec.buckets}, serving checkpoint "
+                 f"step {self.engine.params_step}")
+        self.batcher.start()
+        self._poll_stop.clear()
+        self._poll_thread = threading.Thread(
+            target=self._poll_loop, name="serve-reload", daemon=True)
+        self._poll_thread.start()
+        if self._http_wanted:
+            self._httpd = ThreadingHTTPServer(
+                (self._host, self._port), _make_handler(self))
+            self._httpd.daemon_threads = True
+            self._http_thread = threading.Thread(
+                target=self._httpd.serve_forever, name="serve-http",
+                daemon=True)
+            self._http_thread.start()
+            self.log(f"serve: http on {self.address[0]}:"
+                     f"{self.address[1]}")
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._http_thread = None
+        self._poll_stop.set()
+        if self._poll_thread is not None:
+            self._poll_thread.join(5.0)
+            self._poll_thread = None
+        self.batcher.stop()
+
+    def __enter__(self) -> "InferenceServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    @property
+    def address(self):
+        """(host, port) of the HTTP frontend (port resolved when the
+        constructor asked for 0), or None without HTTP."""
+        return self._httpd.server_address if self._httpd else None
+
+    def _poll_loop(self) -> None:
+        period = max(float(self.engine.spec.reload_poll_s), 0.01)
+        while not self._poll_stop.wait(period):
+            self.engine.poll_reload()
+
+    # -- in-process client API ---------------------------------------------
+    def generate(self, tokens,
+                 timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Submit one prompt and block for the decoded continuation.
+        Raises Overloaded / DeadlineExpired / TimeoutError exactly as
+        the HTTP layer maps them."""
+        t0 = time.monotonic()
+        ticket = self.batcher.submit(tokens, mode="generate",
+                                     timeout=timeout)
+        out = ticket.wait(self._wait_budget(timeout))
+        out["latency_ms"] = round((time.monotonic() - t0) * 1e3, 3)
+        return out
+
+    def predict(self, tokens,
+                timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Next-token log-probs for one prompt (LM scoring)."""
+        t0 = time.monotonic()
+        ticket = self.batcher.submit(tokens, mode="predict",
+                                     timeout=timeout)
+        out = ticket.wait(self._wait_budget(timeout))
+        out["latency_ms"] = round((time.monotonic() - t0) * 1e3, 3)
+        return out
+
+    def _wait_budget(self, timeout: Optional[float]) -> float:
+        # queue deadline + generous dispatch slack: wait() must outlive
+        # the in-queue deadline so expiry surfaces as DeadlineExpired,
+        # not a bare TimeoutError
+        base = (timeout if timeout and timeout > 0
+                else self.engine.spec.request_timeout_s)
+        return max(base, 0.1) + 30.0
+
+    def snapshot(self) -> Dict[str, Any]:
+        out = self.stats.snapshot()
+        out["params_step"] = self.engine.params_step
+        return out
+
+
+def _make_handler(server: InferenceServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet: stats, not stdout
+            pass
+
+        def _reply(self, code: int, payload: Dict[str, Any],
+                   headers: Optional[Dict[str, str]] = None) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/stats":
+                self._reply(200, server.snapshot())
+            elif self.path == "/healthz":
+                self._reply(200, {"ok": True,
+                                  "step": server.engine.params_step})
+            else:
+                self._reply(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            mode = self.path.lstrip("/")
+            if mode not in ("generate", "predict"):
+                self._reply(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                tokens = np.asarray(req["tokens"], np.int32)
+                timeout = req.get("timeout")
+                call = (server.generate if mode == "generate"
+                        else server.predict)
+                self._reply(200, call(tokens, timeout=timeout))
+            except Overloaded as e:
+                self._reply(503, {"error": str(e),
+                                  "retry_after": e.retry_after},
+                            {"Retry-After": f"{e.retry_after:.3f}"})
+            except (DeadlineExpired, TimeoutError) as e:
+                self._reply(504, {"error": str(e)})
+            except (KeyError, ValueError, json.JSONDecodeError) as e:
+                self._reply(400, {"error": f"bad request: {e}"})
+            except Exception as e:  # noqa: BLE001 — failed batch etc.
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+    return Handler

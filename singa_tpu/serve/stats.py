@@ -1,0 +1,123 @@
+"""Serving-tier counters — the inference-side sibling of
+`data.pipeline.PipelineStats`.
+
+One `ServeStats` instance is shared by the `InferenceEngine` (compile /
+reload accounting), the `MicroBatcher` (admission / batching / latency),
+and the `InferenceServer` (the /stats endpoint).  All mutation goes
+through the lock; `snapshot()` is the single read surface, so the HTTP
+handler, the bench smoke, and tests all see the same semantics:
+
+  * latency quantiles (p50/p95) come from a bounded reservoir of the
+    most recent completions — a serving dashboard number, not an exact
+    all-time percentile;
+  * `occupancy` is real requests / bucket batch slots averaged over
+    dispatched micro-batches (1.0 = every padded slot carried a real
+    request);
+  * `qps` is completed requests over the stats object's lifetime;
+  * `compiles` counts engine program compilations — a warmed server
+    must hold this constant (the zero-recompile acceptance gate).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+
+class ServeStats:
+    """Thread-safe serving counters.  See module docstring."""
+
+    def __init__(self, latency_window: int = 2048):
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self._latencies: deque = deque(maxlen=max(int(latency_window), 1))
+        # admission / completion
+        self.submitted = 0
+        self.completed = 0
+        self.failed = 0          # engine/batch errors surfaced to requests
+        self.expired = 0         # deadline passed before dispatch
+        self.shed = 0            # admission rejected (queue full / fault)
+        self.queue_depth = 0     # gauge: requests waiting right now
+        # batching
+        self.batches = 0
+        self.batched_requests = 0
+        self.batch_slots = 0     # sum of bucket batch sizes dispatched
+        # engine
+        self.compiles = 0
+        self.reloads = 0
+        self.reload_failures = 0   # restore raised → kept old params
+        self.reloads_refused = 0   # nothing newer / unhealthy walk-back
+
+    # -- mutation ----------------------------------------------------------
+    def count(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def gauge(self, field: str, value: int) -> None:
+        with self._lock:
+            setattr(self, field, value)
+
+    def observe_batch(self, requests: int, slots: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self.batched_requests += requests
+            self.batch_slots += slots
+
+    def observe_latency(self, seconds: float) -> None:
+        with self._lock:
+            self.completed += 1
+            self._latencies.append(seconds)
+
+    # -- reads -------------------------------------------------------------
+    def latency_quantile(self, q: float) -> Optional[float]:
+        """Seconds at quantile `q` over the recent-completion reservoir
+        (nearest-rank), or None before any completion."""
+        with self._lock:
+            lats = sorted(self._latencies)
+        if not lats:
+            return None
+        idx = min(int(q * len(lats)), len(lats) - 1)
+        return lats[idx]
+
+    def occupancy(self) -> Optional[float]:
+        with self._lock:
+            if self.batch_slots == 0:
+                return None
+            return self.batched_requests / self.batch_slots
+
+    def qps(self) -> float:
+        with self._lock:
+            dt = time.monotonic() - self._t0
+            return self.completed / dt if dt > 0 else 0.0
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-ready view for /stats and BENCH_pr5.json."""
+        p50, p95 = (self.latency_quantile(0.50),
+                    self.latency_quantile(0.95))
+        occ = self.occupancy()
+        with self._lock:
+            out = {
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "failed": self.failed,
+                "expired": self.expired,
+                "shed": self.shed,
+                "queue_depth": self.queue_depth,
+                "batches": self.batches,
+                "batched_requests": self.batched_requests,
+                "batch_slots": self.batch_slots,
+                "compiles": self.compiles,
+                "reloads": self.reloads,
+                "reload_failures": self.reload_failures,
+                "reloads_refused": self.reloads_refused,
+            }
+        out["qps"] = round(self.qps(), 3)
+        out["p50_latency_ms"] = (round(p50 * 1e3, 3)
+                                 if p50 is not None else None)
+        out["p95_latency_ms"] = (round(p95 * 1e3, 3)
+                                 if p95 is not None else None)
+        out["batch_occupancy"] = (round(occ, 4) if occ is not None
+                                  else None)
+        return out

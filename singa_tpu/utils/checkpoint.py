@@ -249,6 +249,10 @@ class CheckpointManager:
         """All snapshot steps present on disk, ascending (valid or not —
         restore decides validity)."""
         if self._mgr is not None:
+            # orbax caches the step list per manager instance; refresh
+            # from disk so a reader sees saves made by OTHER managers
+            # (the serving tier polls the trainer's workspace)
+            self._mgr.reload()
             return sorted(self._mgr.all_steps())
         return sorted(int(f[5:-4]) for f in os.listdir(self.dir)
                       if f.startswith("step_") and f.endswith(".npz"))
@@ -256,6 +260,20 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         steps = self.available_steps()
         return steps[-1] if steps else None
+
+    def fingerprint(self) -> tuple:
+        """Cheap change token for hot-reload polling (serve tier): the
+        set of snapshot steps on disk plus the MANIFEST.json stat
+        (mtime_ns, size).  A new save — or a re-save carrying a new
+        health verdict — changes it; comparing tokens costs two
+        directory stats, no file reads, so a server can poll every
+        second without touching snapshot data."""
+        try:
+            st = os.stat(self._manifest_path())
+            man = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            man = None
+        return (tuple(self.available_steps()), man)
 
     def restore(self, step: Optional[int] = None,
                 template: Optional[Dict[str, Any]] = None,
@@ -315,7 +333,21 @@ class CheckpointManager:
                 state = self._mgr.restore(
                     step, args=ocp.args.StandardRestore(target))
             else:
-                state = self._mgr.restore(step)
+                # templateless restore (serving tier: the engine knows
+                # params only, not the optimizer tree) — orbax rebuilds
+                # the saved topology; safe here because save() always
+                # writes the same {params, opt_state, step} triple.
+                # orbax warns about exactly this on every call, which
+                # would spam the serving reload poll — mute it.
+                import logging
+                absl_log = logging.getLogger("absl")
+                prev = absl_log.level
+                absl_log.setLevel(logging.ERROR)
+                try:
+                    state = self._mgr.restore(
+                        step, args=ocp.args.StandardRestore())
+                finally:
+                    absl_log.setLevel(prev)
             return state["params"], state["opt_state"], int(state["step"])
         path = self._verify_fallback(step)
         if path is None:

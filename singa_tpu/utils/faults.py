@@ -32,6 +32,15 @@ restart — so a one-shot fault never re-fires during recovery):
                    per step; the silent kinds poison the compiled
                    step's grads so numeric-health detection is
                    testable on CPU)
+    serve.admit    one request admitted to the serving queue
+                   (MicroBatcher.submit — an error sheds the request
+                   with a Backoff retry hint instead of crashing)
+    serve.batch    one micro-batch dispatched to the inference engine
+                   (MicroBatcher dispatch loop — an error fails that
+                   batch's requests; the server stays up)
+    serve.reload   one checkpoint hot-reload attempt
+                   (InferenceEngine.poll_reload — an error degrades to
+                   keep-serving-old-params, counted in ServeStats)
 
 Fault kinds:
 
@@ -66,7 +75,7 @@ from typing import Dict, List, Optional
 
 SITES = ("data.decode", "data.prefetch", "feed.stage", "ckpt.save",
          "ckpt.restore", "sync.elastic", "sync.delta", "step.train",
-         "step.grad")
+         "step.grad", "serve.admit", "serve.batch", "serve.reload")
 
 KINDS = ("error", "preempt", "corrupt", "torn", "nan", "spike")
 

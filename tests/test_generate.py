@@ -241,6 +241,12 @@ def test_beam_search_eos_freezes_beam():
     toks, _ = beam_search(net, params, prompt, 6, num_beams=2,
                           eos_id=eos)
     row = np.asarray(toks)[0]
+    # np.argmax(row == eos) returns 0 on an all-False row, so assert the
+    # winner actually emitted eos first — a non-eos winning beam should
+    # fail HERE with a clear message, not downstream for the wrong reason
+    assert eos in row, (
+        f"winning beam never emitted eos={eos} (row={row.tolist()}): the "
+        f"greedy next token should make eos the top continuation")
     # once eos appears every later slot is eos (the frozen-beam contract)
     hit = np.argmax(row == eos)
     assert row[hit] == eos and (row[hit:] == eos).all()
