@@ -506,6 +506,70 @@ def bench_serve_smoke(n_clients=6, reqs_per_client=5, out=None):
     return result
 
 
+def bench_obs_overhead(batch_size=64, steps=96, scan_chunk=8,
+                       reps=3, out=None):
+    """ISSUE 6 acceptance: `--obs on` must cost < 3% wall time on the
+    chunked LeNet training loop (the span-per-chunk hot path: one
+    trainer.chunk + feeder.stage span pair per dispatch, plus the
+    trace buffer append).  A/B of identical runs — obs off vs obs on
+    with trace + event log under a temp dir — best-of-`reps` each leg
+    to shave scheduler noise.  `value` is the overhead fraction
+    (on/off - 1); `out` writes the JSON line as well
+    (scripts/obs_smoke.sh -> BENCH_pr6.json)."""
+    import tempfile
+
+    import jax
+
+    from singa_tpu import obs
+    from singa_tpu.data.synthetic import synthetic_image_batches
+
+    trainer, _, _, _ = _lenet_trainer(batch_size)
+    trainer.cfg.train_steps = steps
+    trainer.cfg.display_frequency = 0
+    trainer.cfg.test_frequency = 0
+    trainer.cfg.checkpoint_frequency = 0
+
+    def one():
+        params, opt_state = trainer.init(seed=0)
+        it = synthetic_image_batches(batch_size, seed=1, stream_seed=7)
+        t0 = time.perf_counter()
+        trainer.run(params, opt_state, it, seed=0,
+                    scan_chunk=scan_chunk)
+        return time.perf_counter() - t0
+
+    one()   # warm the compile caches so both legs are steady-state
+    tmp = tempfile.mkdtemp(prefix="obs_bench_")
+    spec = obs.ObsSpec(trace=os.path.join(tmp, "trace.json"),
+                       events=os.path.join(tmp, "events.jsonl"))
+
+    # interleaved A/B reps: host drift (thermal, allocator state)
+    # hits both legs equally instead of biasing whichever ran last
+    off = on = float("inf")
+    for _ in range(reps):
+        off = min(off, one())
+        with obs.session(spec):
+            on = min(on, one())
+    overhead = on / off - 1.0
+    result = {
+        "metric": "obs_overhead",
+        "value": round(overhead, 4),
+        "unit": "wall_time_fraction",
+        "gate": 0.03,
+        "passed": overhead < 0.03,
+        "wall_obs_off_s": round(off, 4),
+        "wall_obs_on_s": round(on, 4),
+        "batch": batch_size, "steps": steps, "scan_chunk": scan_chunk,
+        "reps": reps,
+        "backend": jax.default_backend(),
+        "cpu_count": os.cpu_count(),
+    }
+    line = json.dumps(result)
+    if out:
+        with open(out, "w") as f:
+            f.write(line + "\n")
+    return result
+
+
 def _convergence_aux():
     path = os.path.join(REPO, "CONVERGENCE.json")
     if not os.path.exists(path):
@@ -538,6 +602,12 @@ def main() -> None:
         if "--out" in sys.argv:
             out = sys.argv[sys.argv.index("--out") + 1]
         print(json.dumps(bench_serve_smoke(out=out)))
+        return
+    if "--obs-overhead" in sys.argv:
+        out = None
+        if "--out" in sys.argv:
+            out = sys.argv[sys.argv.index("--out") + 1]
+        print(json.dumps(bench_obs_overhead(out=out)))
         return
     # transformer FIRST: round 3 recorded it at 0.4996 because it ran
     # after the full AlexNet bench on a session-warmed chip; the

@@ -44,6 +44,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterator, List, Optional
 
+from .. import obs
 from ..utils.faults import Backoff, Preemption, retry_call
 from ..utils.health import NumericDivergence
 
@@ -143,20 +144,25 @@ class Supervisor:
                                   self.trainer.train_net, opt)
         return params, opt
 
-    def _restore(self, params, opt, seed: int):
+    def _restore(self, params, opt, seed: int,
+                 corr: Optional[str] = None):
         """RESTORE: latest valid snapshot, with its own (small) retry
         budget — a flaky restore read is not a training failure.  After
         a divergence the restore also skips snapshots with a bad health
         verdict (rollback PAST the unhealthy window)."""
         if not self.workspace:
             return params, opt, 0
-        return retry_call(
-            lambda: self.trainer.resume(
-                params, opt, self.workspace,
-                skip_unhealthy=self._skip_unhealthy),
-            attempts=self.restore_retries,
-            backoff=Backoff(base=0.1, cap=5.0, seed=seed),
-            log=self.log, what="checkpoint restore")
+        with obs.span("supervisor.restore", corr=corr,
+                      skip_unhealthy=self._skip_unhealthy) as sp:
+            out = retry_call(
+                lambda: self.trainer.resume(
+                    params, opt, self.workspace,
+                    skip_unhealthy=self._skip_unhealthy),
+                attempts=self.restore_retries,
+                backoff=Backoff(base=0.1, cap=5.0, seed=seed),
+                log=self.log, what="checkpoint restore")
+            sp.set(step=out[2])
+        return out
 
     def _make_iter(self, factory: Callable[..., Iterator],
                    start_step: int) -> Iterator:
@@ -225,6 +231,7 @@ class Supervisor:
             probes += list(hooks)
         while True:
             attempt += 1
+            corr = f"attempt-{attempt}"
             monitor = getattr(self.trainer, "health", None)
             if monitor is not None:
                 # rolling statistics from a poisoned attempt must not
@@ -233,25 +240,34 @@ class Supervisor:
             params, opt = self._fresh_state(seed)
             start_step = 0
             if self.workspace and (resume or attempt > 1):
-                params, opt, start_step = self._restore(params, opt, seed)
+                params, opt, start_step = self._restore(params, opt,
+                                                        seed, corr=corr)
                 if start_step > 0:
                     self.log(f"supervisor: resumed from step "
                              f"{start_step} (attempt {attempt})")
+                    obs.emit_event("supervisor.resumed",
+                                   corr=corr, attempt=attempt,
+                                   step=start_step)
                 elif attempt > 1:
                     self.log("supervisor: no valid checkpoint; "
                              "replaying from step 0")
             it = None
             try:
                 # inside the try: a data-source failure during rebuild
-                # or fast-forward is retried like any step failure
-                it = self._make_iter(train_iter_factory, start_step)
-                return self.trainer.run(
-                    params, opt, it,
-                    test_iter_factory=test_iter_factory,
-                    val_iter_factory=val_iter_factory,
-                    start_step=start_step, seed=seed, hooks=probes,
-                    workspace=self.workspace, scan_chunk=scan_chunk,
-                    feeder=feeder, feeder_depth=feeder_depth)
+                # or fast-forward is retried like any step failure.
+                # The attempt span carries the recovery correlation id:
+                # trainer chunk / drain / checkpoint spans open inside
+                # it (same thread) and inherit `attempt-N`.
+                with obs.span("supervisor.attempt", corr=corr,
+                              attempt=attempt, start_step=start_step):
+                    it = self._make_iter(train_iter_factory, start_step)
+                    return self.trainer.run(
+                        params, opt, it,
+                        test_iter_factory=test_iter_factory,
+                        val_iter_factory=val_iter_factory,
+                        start_step=start_step, seed=seed, hooks=probes,
+                        workspace=self.workspace, scan_chunk=scan_chunk,
+                        feeder=feeder, feeder_depth=feeder_depth)
             except Preemption as e:
                 preemptions += 1
                 self._record(attempt, "preemption", e, last_seen[0])
@@ -297,21 +313,24 @@ class Supervisor:
         """Divergence rescue policy: arm skip-unhealthy restores, blame
         the batches at the crash step, and (once) back off the learning
         rate.  Retries immediately — backoff sleeps don't fix NaNs."""
-        self._skip_unhealthy = True
-        actions = ["rolling back past the unhealthy window"]
-        if self.blame_batches > 0:
-            first = max(e.step, 0)
-            blamed = range(first, first + self.blame_batches)
-            self._blame.update(blamed)
-            actions.append(f"blaming batches "
-                           f"[{first}, {first + self.blame_batches})")
-        if self.lr_backoff and not self._lr_backed_off:
-            scale = self.trainer.apply_lr_backoff(self.lr_backoff)
-            self._lr_backed_off = True
-            actions.append(f"LR backoff x{self.lr_backoff:g} "
-                           f"(scale now {scale:g})")
-        self.log(f"supervisor: numeric divergence at step {e.step} "
-                 f"({e}); {'; '.join(actions)}; retrying immediately")
+        with obs.span("supervisor.rescue", step=e.step):
+            self._skip_unhealthy = True
+            actions = ["rolling back past the unhealthy window"]
+            if self.blame_batches > 0:
+                first = max(e.step, 0)
+                blamed = range(first, first + self.blame_batches)
+                self._blame.update(blamed)
+                actions.append(f"blaming batches "
+                               f"[{first}, {first + self.blame_batches})")
+            if self.lr_backoff and not self._lr_backed_off:
+                scale = self.trainer.apply_lr_backoff(self.lr_backoff)
+                self._lr_backed_off = True
+                actions.append(f"LR backoff x{self.lr_backoff:g} "
+                               f"(scale now {scale:g})")
+            self.log(f"supervisor: numeric divergence at step {e.step} "
+                     f"({e}); {'; '.join(actions)}; retrying immediately")
+            obs.emit_event("supervisor.rescue", step=e.step,
+                           actions=actions, error=repr(e))
 
     def _record(self, attempt: int, kind: str, exc: BaseException,
                 last_step: int) -> None:
@@ -326,6 +345,12 @@ class Supervisor:
         self.failures.append(FailureRecord(
             attempt=attempt, kind=kind, error=repr(exc),
             last_step=last_step, restart_step=restart))
+        obs.emit_event("supervisor.restart", corr=f"attempt-{attempt}",
+                       attempt=attempt, fail_kind=kind,
+                       error=repr(exc), last_step=last_step,
+                       restart_step=restart)
 
     def _abort(self, why: str) -> TrainingAborted:
+        obs.emit_event("supervisor.abort", why=why,
+                       failures=len(self.failures))
         return TrainingAborted(f"training aborted: {why}", self.failures)

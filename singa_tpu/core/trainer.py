@@ -25,6 +25,7 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from .. import obs
 from ..config.schema import ModelConfig
 from ..utils import faults
 from .net import NeuralNet, build_net
@@ -97,6 +98,25 @@ class TimerInfo:
         self.times.clear()
         self.steps = 0
 
+    def register_into(self, registry,
+                      prefix: str = "singa_train") -> None:
+        """Register this timer's phase totals into an
+        `obs.MetricsRegistry` as a pull-time collector — additive; the
+        timer's own API and report are untouched."""
+        from ..obs.metrics import Sample
+
+        def collect():
+            out = [Sample(f"{prefix}_steps_total", "counter",
+                          "training steps timed", float(self.steps))]
+            for phase, secs in sorted(self.times.items()):
+                out.append(Sample(
+                    f"{prefix}_phase_{phase}_seconds_total", "counter",
+                    f"cumulative host seconds in the {phase!r} phase",
+                    secs))
+            return out
+
+        registry.register_collector(collect)
+
 
 class Trainer:
     """Single-controller training driver.
@@ -107,7 +127,7 @@ class Trainer:
 
     def __init__(self, model_cfg: ModelConfig,
                  input_shapes: Dict[str, Dict[str, tuple]],
-                 log_fn: Callable[[str], None] = print,
+                 log_fn: Optional[Callable[[str], None]] = None,
                  donate: bool = True, mesh=None, n_micro: int = 0,
                  ngroups: int = 1, health=None):
         """`mesh` + layers carrying locationid stage marks → the staged
@@ -132,7 +152,12 @@ class Trainer:
         are gated on) the window's health verdict.  None (the default)
         compiles exactly the pre-health step program."""
         self.cfg = model_cfg
-        self.log = log_fn
+        # default: the structured component logger (obs.log satellite)
+        # — human-readable "[trainer] ..." lines, warning+ mirrored to
+        # the event log when a session is live.  A caller-provided
+        # log_fn (tests, serve_main) is used verbatim as before.
+        self.log = log_fn if log_fn is not None \
+            else obs.get_logger("trainer")
         self.mesh = mesh
         self.health = health
         self._donate = donate
@@ -152,7 +177,7 @@ class Trainer:
         self._pipeline_nets = self._maybe_pipeline(n_micro)
         from ..parallel.elastic import ElasticController, async_active
         self.elastic = (ElasticController(model_cfg.updater, ngroups,
-                                          log_fn=log_fn)
+                                          log_fn=self.log)
                         if async_active(model_cfg.updater) else None)
         self._build_steps(donate)
         self.perf = Performance()
@@ -737,6 +762,12 @@ class Trainer:
         last_dbg = [None]       # newest single-batch view (debug/profile)
 
         def _drain():
+            if not pending:
+                return
+            with obs.span("trainer.drain", chunks=len(pending)):
+                _drain_chunks()
+
+        def _drain_chunks():
             while pending:
                 s0, n, md, stacked = pending.pop(0)
                 tg = time.perf_counter()
@@ -753,6 +784,15 @@ class Trainer:
                         # attempt BEFORE this step reaches hooks or a
                         # checkpoint (the save below drains first)
                         verdict = self.health.observe(s, m)
+                        if verdict.status != "ok":
+                            obs.emit_event(
+                                "health.verdict", step=s,
+                                status=verdict.status,
+                                metric=verdict.metric,
+                                value=(float(verdict.value)
+                                       if verdict.value is not None
+                                       else None),
+                                fatal=verdict.fatal)
                         if verdict.fatal:
                             raise verdict.to_error()
                     self.perf.update(m)
@@ -809,15 +849,18 @@ class Trainer:
                     t1 = time.perf_counter()
                     batch = self._batch_place(batch)
                     t2 = time.perf_counter()
-                    params, opt_state, metrics = self.train_step(
-                        params, opt_state, batch, step,
-                        jax.random.fold_in(rng, step),
-                        poison[0] if poison is not None else None)
+                    with obs.span("trainer.chunk", start=step, steps=1):
+                        params, opt_state, metrics = self.train_step(
+                            params, opt_state, batch, step,
+                            jax.random.fold_in(rng, step),
+                            poison[0] if poison is not None else None)
                     t3 = time.perf_counter()
                     pending.append((step, 1, metrics, False))
                     last_dbg[0] = batch
                 elif fd is not None:
-                    chunk = fd.get()   # blocks only if staging is behind
+                    with obs.span("feeder.wait", start=step):
+                        # blocks only if staging is behind
+                        chunk = fd.get()
                     t1 = time.perf_counter()
                     if chunk.start != step or chunk.length != n:
                         from ..data.feed import FeedError
@@ -826,9 +869,10 @@ class Trainer:
                             f"[{chunk.start}, +{chunk.length}) vs loop "
                             f"[{step}, +{n})")
                     t2 = t1
-                    params, opt_state, metrics = self.train_steps(
-                        params, opt_state, chunk.batches, step, rng, n,
-                        True, poison)
+                    with obs.span("trainer.chunk", start=step, steps=n):
+                        params, opt_state, metrics = self.train_steps(
+                            params, opt_state, chunk.batches, step, rng,
+                            n, True, poison)
                     t3 = time.perf_counter()
                     pending.append((step, n, metrics, True))
                     last_dbg[0] = jax.tree_util.tree_map(
@@ -841,11 +885,13 @@ class Trainer:
                 else:
                     batches = [next(train_iter) for _ in range(n)]
                     t1 = time.perf_counter()
-                    stacked = stager.stage(batches)
+                    with obs.span("feeder.stage", start=step, steps=n):
+                        stacked = stager.stage(batches)
                     t2 = time.perf_counter()
-                    params, opt_state, metrics = self.train_steps(
-                        params, opt_state, stacked, step, rng, n, True,
-                        poison)
+                    with obs.span("trainer.chunk", start=step, steps=n):
+                        params, opt_state, metrics = self.train_steps(
+                            params, opt_state, stacked, step, rng, n,
+                            True, poison)
                     t3 = time.perf_counter()
                     pending.append((step, n, metrics, True))
                     last_dbg[0] = jax.tree_util.tree_map(
@@ -943,6 +989,8 @@ class Trainer:
             self.log(f"health: refusing checkpoint at step {step} "
                      f"(verdict {rec['verdict']!r} — restoring this "
                      f"snapshot would resume the divergence)")
+            obs.emit_event("ckpt.refused", step=step,
+                           verdict=rec["verdict"])
             return False
         ckpt.save(step, *self._ckpt_state(params, opt_state),
                   health=self.health.snapshot_health())

@@ -43,6 +43,7 @@ from typing import Any, Callable, Iterable, Iterator, List, NamedTuple, Optional
 
 import numpy as np
 
+from .. import obs
 from ..utils.faults import maybe_fault
 from .pipeline import PrefetchError, ProducerDied, poll_queue
 
@@ -233,6 +234,11 @@ class DeviceFeeder:
         self.pull_seconds = 0.0
         self.stage_seconds = 0.0
         self.chunks_staged = 0
+        # the consumer constructs the feeder inside its recovery span
+        # (Supervisor attempt); capture that correlation id HERE so
+        # producer-thread spans carry it — thread-local span stacks
+        # don't cross the staging thread
+        self._corr = obs.current_corr()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -253,11 +259,15 @@ class DeviceFeeder:
                 if self._stop.is_set():
                     return
                 t0 = time.perf_counter()
-                batches = []
-                for _ in range(n):
-                    batches.append(next(self._it))
+                with obs.span("feeder.pull", corr=self._corr,
+                              start=start, steps=n):
+                    batches = []
+                    for _ in range(n):
+                        batches.append(next(self._it))
                 t1 = time.perf_counter()
-                placed = self._stager.stage(batches)
+                with obs.span("feeder.stage", corr=self._corr,
+                              start=start, steps=n):
+                    placed = self._stager.stage(batches)
                 t2 = time.perf_counter()
                 self.pull_seconds += t1 - t0
                 self.stage_seconds += t2 - t1

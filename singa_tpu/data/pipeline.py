@@ -95,6 +95,26 @@ class PipelineStats:
             self.passes += 1
             return n
 
+    def register_into(self, registry,
+                      prefix: str = "singa_data") -> None:
+        """Register these counters into an `obs.MetricsRegistry` as a
+        pull-time collector — additive; existing semantics untouched."""
+        from ..obs.metrics import Sample
+
+        def collect():
+            with self._lock:
+                q, p = self.quarantined, self.passes
+            return [
+                Sample(f"{prefix}_quarantined_total", "counter",
+                       "corrupt records skipped instead of crashing",
+                       float(q)),
+                Sample(f"{prefix}_passes_total", "counter",
+                       "completed read passes over the source",
+                       float(p)),
+            ]
+
+        registry.register_collector(collect)
+
 
 def _decode_batch(vals: List[bytes], data_layer: str) -> Dict:
     """Decode a batch of serialized records — native C++ batch decoder

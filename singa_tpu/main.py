@@ -15,14 +15,18 @@ Serving (the inference tier, singa_tpu/serve/):
     python -m singa_tpu.main serve -model_conf lm.conf \
         --workspace ws [--port 8000] [--serve_spec 'buckets=4x16/8x32,...']
 follows the trainer's checkpoints in the workspace (hot-reload) and
-serves /generate, /predict, /stats, /healthz over stdlib HTTP.
+serves /generate, /predict, /stats, /metrics, /healthz over stdlib
+HTTP.  Both subcommands take `--obs on [--obs_spec ...]` for the
+unified telemetry layer (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
+from . import obs
 from .config import load_cluster_config, load_model_config
 from .core.trainer import Trainer
 
@@ -94,7 +98,44 @@ def make_argparser() -> argparse.ArgumentParser:
                     help="measure the device fwd/bwd/update split once "
                          "(profiler trace) and report it at every "
                          "display interval (worker.h:91-114 parity)")
+    _add_obs_flags(ap)
     return ap
+
+
+def _add_obs_flags(ap: argparse.ArgumentParser) -> None:
+    ap.add_argument("--obs", choices=("on", "off"), default="off",
+                    help="unified telemetry: span tracing (Chrome "
+                         "trace JSON, Perfetto-loadable), a metrics "
+                         "registry, and a structured JSONL event log "
+                         "(see docs/OBSERVABILITY.md); artifacts "
+                         "default under <workspace>/obs/")
+    ap.add_argument("--obs_spec", default=None,
+                    help="telemetry config: comma-separated key=value "
+                         "over the ObsSpec fields, e.g. "
+                         "'trace=/tmp/t.json,events=/tmp/e.jsonl,"
+                         "metrics_period_s=5,max_spans=100000' "
+                         "(singa_tpu/obs/__init__.py)")
+
+
+def _obs_enable(args, workspace=None) -> bool:
+    """Arm the process-global telemetry session from --obs/--obs_spec.
+    Bare `--obs on` defaults both artifacts under `<workspace>/obs/`
+    (`./obs/` without a workspace).  Returns True when a session was
+    installed — the caller owns the matching `obs.disable()`."""
+    if getattr(args, "obs", "off") != "on":
+        if getattr(args, "obs_spec", None):
+            obs.get_logger("main")("warning: --obs_spec given with "
+                                   "--obs off; telemetry stays "
+                                   "disabled")
+        return False
+    spec = obs.ObsSpec.parse(getattr(args, "obs_spec", None))
+    base = os.path.join(workspace or ".", "obs")
+    if not spec.trace:
+        spec.trace = os.path.join(base, "trace.json")
+    if not spec.events:
+        spec.events = os.path.join(base, "events.jsonl")
+    obs.enable(spec)
+    return True
 
 
 def make_serve_argparser() -> argparse.ArgumentParser:
@@ -128,6 +169,7 @@ def make_serve_argparser() -> argparse.ArgumentParser:
     ap.add_argument("--fault_spec", default=None,
                     help="deterministic fault injection over the "
                          "serve.* sites (singa_tpu/utils/faults.py)")
+    _add_obs_flags(ap)
     return ap
 
 
@@ -140,61 +182,72 @@ def serve_main(argv) -> int:
     from .utils.faults import FaultSchedule, inject
     schedule = (FaultSchedule.parse(args.fault_spec, seed=args.seed)
                 if args.fault_spec else None)
+    log = obs.get_logger("serve")
+    obs_on = _obs_enable(args, args.workspace)
+    try:
+        model = load_model_config(args.model_conf)
+        from .data import discover_input_shapes
+        input_shapes = discover_input_shapes(model, force_synthetic=True)
+        trainer = Trainer(model, input_shapes, log_fn=lambda s: None)
+        # the inference net: test phase when the config defines one,
+        # else the train net (same params either way)
+        net = trainer.test_net or trainer.train_net
 
-    model = load_model_config(args.model_conf)
-    from .data import discover_input_shapes
-    input_shapes = discover_input_shapes(model, force_synthetic=True)
-    trainer = Trainer(model, input_shapes, log_fn=lambda s: None)
-    # the inference net: test phase when the config defines one, else
-    # the train net (same params either way)
-    net = trainer.test_net or trainer.train_net
+        import jax
 
-    import jax
+        from .serve import InferenceEngine, InferenceServer, ServeSpec
+        spec = (ServeSpec.parse(args.serve_spec) if args.serve_spec
+                else ServeSpec())
+        # fresh-init fallback so a checkpoint-less workspace still
+        # serves (engine.load prefers any restorable healthy snapshot)
+        fallback = net.init_params(jax.random.PRNGKey(args.seed))
+        engine = InferenceEngine(net, spec, workspace=args.workspace,
+                                 params=fallback, log_fn=log)
+        reg = obs.registry()
+        if reg is not None:
+            engine.stats.register_into(reg)
 
-    from .serve import InferenceEngine, InferenceServer, ServeSpec
-    spec = (ServeSpec.parse(args.serve_spec) if args.serve_spec
-            else ServeSpec())
-    # fresh-init fallback so a checkpoint-less workspace still serves
-    # (engine.load prefers any restorable healthy snapshot)
-    fallback = net.init_params(jax.random.PRNGKey(args.seed))
-    engine = InferenceEngine(net, spec, workspace=args.workspace,
-                             params=fallback, log_fn=print)
-
-    with inject(schedule):
-        if schedule is not None:
-            print(f"fault injection active: {args.fault_spec} "
-                  f"(seed {args.seed})")
-        server = InferenceServer(engine, host=args.host,
-                                 port=args.port,
-                                 http=(args.smoke == 0), log_fn=print)
-        server.start()
-        if engine.params_step < 0:
-            print("warning: serving fresh-init params (no restorable "
-                  "checkpoint in the workspace)", file=sys.stderr)
-        try:
-            if args.smoke > 0:
-                import numpy as np
-                rng = np.random.default_rng(args.seed)
-                vocab = _serve_vocab(net)
-                for i in range(args.smoke):
-                    plen = int(rng.integers(1, spec.max_prompt_len + 1))
-                    prompt = rng.integers(0, vocab, plen).astype("int32")
-                    out = server.generate(prompt)
-                    print(f"smoke {i}: plen={plen} -> "
-                          f"{len(out['tokens'])} tokens "
-                          f"(step {out['step']}, "
-                          f"bucket {out['bucket']})")
+        with inject(schedule):
+            if schedule is not None:
+                log(f"fault injection active: {args.fault_spec} "
+                    f"(seed {args.seed})")
+            server = InferenceServer(engine, host=args.host,
+                                     port=args.port,
+                                     http=(args.smoke == 0),
+                                     log_fn=log)
+            server.start()
+            if engine.params_step < 0:
+                log("warning: serving fresh-init params (no "
+                    "restorable checkpoint in the workspace)")
+            try:
+                if args.smoke > 0:
+                    import numpy as np
+                    rng = np.random.default_rng(args.seed)
+                    vocab = _serve_vocab(net)
+                    for i in range(args.smoke):
+                        plen = int(rng.integers(
+                            1, spec.max_prompt_len + 1))
+                        prompt = rng.integers(0, vocab,
+                                              plen).astype("int32")
+                        out = server.generate(prompt)
+                        log(f"smoke {i}: plen={plen} -> "
+                            f"{len(out['tokens'])} tokens "
+                            f"(step {out['step']}, "
+                            f"bucket {out['bucket']})")
+                    print(_json.dumps(server.snapshot()))
+                    return 0
+                import time
+                while True:
+                    time.sleep(3600)
+            except KeyboardInterrupt:
+                log("serve: shutting down")
                 print(_json.dumps(server.snapshot()))
                 return 0
-            import time
-            while True:
-                time.sleep(3600)
-        except KeyboardInterrupt:
-            print("\nserve: shutting down")
-            print(_json.dumps(server.snapshot()))
-            return 0
-        finally:
-            server.stop()
+            finally:
+                server.stop()
+    finally:
+        if obs_on:
+            obs.disable()
 
 
 def _serve_vocab(net) -> int:
@@ -214,14 +267,21 @@ def main(argv=None) -> int:
     from .utils.faults import FaultSchedule, inject
     schedule = (FaultSchedule.parse(args.fault_spec, seed=args.seed)
                 if args.fault_spec else None)
-    if schedule is not None:
-        print(f"fault injection active: {args.fault_spec} "
-              f"(seed {args.seed})")
-    with inject(schedule):
-        return _run(args)
+    obs_on = _obs_enable(args, args.workspace)
+    try:
+        if schedule is not None:
+            obs.get_logger("main")(
+                f"fault injection active: {args.fault_spec} "
+                f"(seed {args.seed})")
+        with inject(schedule):
+            return _run(args)
+    finally:
+        if obs_on:
+            obs.disable()
 
 
 def _run(args) -> int:
+    log = obs.get_logger("main")
     model = load_model_config(args.model_conf)
     cluster = (load_cluster_config(args.cluster_conf)
                if args.cluster_conf else None)
@@ -233,7 +293,7 @@ def _run(args) -> int:
         from .parallel.bootstrap import DEFAULT_PORT, distributed_init
         port = cluster.start_port if cluster else DEFAULT_PORT
         if distributed_init(args.procsID, args.hostfile, port=port):
-            print(f"jax.distributed initialized: process {args.procsID}")
+            log(f"jax.distributed initialized: process {args.procsID}")
     if args.steps is not None:
         model.train_steps = args.steps
 
@@ -259,7 +319,7 @@ def _run(args) -> int:
         from .parallel import mesh_from_cluster
         ptype = model.neuralnet.partition_type if model.neuralnet else "kNone"
         mesh = mesh_from_cluster(cluster, ptype)
-        print(f"mesh: {dict(mesh.shape)} over {len(jax.devices())} devices")
+        log(f"mesh: {dict(mesh.shape)} over {len(jax.devices())} devices")
 
     # worker-group topology (cluster.h:49-60): nworkers/nprocs_per_group
     # data-parallel groups; with the async consistency tier active each
@@ -273,18 +333,26 @@ def _run(args) -> int:
     # when armed; --health off restores the exact pre-health program
     from .utils.health import HealthMonitor, HealthSpec
     health_spec = HealthSpec.parse(args.health_spec)
-    health = (HealthMonitor(health_spec, log_fn=print)
+    health = (HealthMonitor(health_spec,
+                            log_fn=obs.get_logger("health"))
               if args.health == "on" else None)
     if args.health == "off" and args.health_spec:
-        print("warning: --health_spec given with --health off; the "
-              "monitor is disabled and the spec only configures the "
-              "supervisor's divergence policy", file=sys.stderr)
+        log("warning: --health_spec given with --health off; the "
+            "monitor is disabled and the spec only configures the "
+            "supervisor's divergence policy")
 
     trainer = Trainer(model, input_shapes, mesh=mesh,
                       n_micro=(cluster.pipeline_microbatches
                                if cluster else 0),
                       ngroups=ngroups, health=health)
     trainer.phase_profile = args.phase_profile
+    # additive metric collectors (no-op without --obs on): the per-phase
+    # timer and the health-verdict tallies feed the periodic dump
+    reg = obs.registry()
+    if reg is not None:
+        trainer.timer.register_into(reg)
+        if health is not None:
+            health.register_into(reg)
 
     from .parallel.elastic import async_active
     async_multi = ngroups > 1 and async_active(model.updater)
@@ -321,11 +389,10 @@ def _run(args) -> int:
                            (workspace, "checkpointing (workspace)"),
                            (mesh is not None, "mesh sharding")):
             if flag:
-                print(f"warning: {what} is not supported on the "
-                      f"multi-group async simulation path; ignoring",
-                      file=sys.stderr)
-        print(f"async replica groups: {ngroups} x "
-              f"{model.updater.param_type}")
+                log(f"warning: {what} is not supported on the "
+                    f"multi-group async simulation path; ignoring")
+        log(f"async replica groups: {ngroups} x "
+            f"{model.updater.param_type}")
         # ClusterProto.bandwidth/nservers drive the runtime SyncConfig
         # (param_manager.cc:85-93): after warmup the RandomSync sample
         # ratio adapts to the configured pipe
@@ -344,10 +411,10 @@ def _run(args) -> int:
         center, history = rs.run(iters, model.train_steps,
                                  seed=args.seed)
         last = history[0][-1] if history and history[0] else {}
-        print(f"training done (center of {ngroups} replicas)" +
-              (": " + ", ".join(f"{k} : {v:.6f}"
-                                for k, v in sorted(last.items()))
-               if last else ""))
+        log(f"training done (center of {ngroups} replicas)" +
+            (": " + ", ".join(f"{k} : {v:.6f}"
+                              for k, v in sorted(last.items()))
+             if last else ""))
         test_factory = resolve_data_source(
             model, bs, seed=args.seed,
             force_synthetic=args.synthetic,
@@ -356,7 +423,7 @@ def _run(args) -> int:
                 and center is not None and model.test_steps > 0:
             avg = trainer.evaluate(center, test_factory(),
                                    model.test_steps, trainer.test_step)
-            print("center test: " + ", ".join(
+            log("center test: " + ", ".join(
                 f"{k} : {v:.6f}" for k, v in sorted(avg.items())))
         return 0
 
@@ -375,17 +442,16 @@ def _run(args) -> int:
         sample_shapes=input_shapes)
 
     if args.resume and not workspace:
-        print("warning: --resume given but no workspace configured "
-              "(set --workspace or ClusterProto.workspace); "
-              "starting from scratch", file=sys.stderr)
+        log("warning: --resume given but no workspace configured "
+            "(set --workspace or ClusterProto.workspace); "
+            "starting from scratch")
 
     # auto → None: Trainer.run resolves via SINGA_TPU_FEEDER (default on
     # for chunked loops)
     feeder_flag = {"auto": None, "on": True, "off": False}[args.feeder]
     if args.feeder == "on" and args.scan_chunk <= 1:
-        print("warning: --feeder on has no effect without "
-              "--scan_chunk > 1 (the feeder stages whole scan chunks)",
-              file=sys.stderr)
+        log("warning: --feeder on has no effect without "
+            "--scan_chunk > 1 (the feeder stages whole scan chunks)")
 
     if args.max_restarts > 0:
         # supervised runtime: restore-the-last-valid-snapshot + replay
@@ -396,7 +462,8 @@ def _run(args) -> int:
                          max_restarts=args.max_restarts,
                          max_divergences=health_spec.max_divergences,
                          blame_batches=health_spec.blame_batches,
-                         lr_backoff=health_spec.lr_backoff, log=print)
+                         lr_backoff=health_spec.lr_backoff,
+                         log=obs.get_logger("supervisor"))
         try:
             params, opt_state, history = sup.run(
                 make_train_iter, test_iter_factory=test_factory,
@@ -404,7 +471,7 @@ def _run(args) -> int:
                 resume=args.resume, feeder=feeder_flag,
                 feeder_depth=args.feeder_depth)
         except TrainingAborted as e:
-            print(f"error: {e}", file=sys.stderr)
+            log(f"error: {e}")
             return 1
     else:
         params, opt_state = trainer.init(seed=args.seed)
@@ -418,10 +485,10 @@ def _run(args) -> int:
             params, opt_state, start_step = trainer.resume(
                 params, opt_state, workspace)
             if start_step > 0:
-                print(f"resumed from step {start_step}")
+                log(f"resumed from step {start_step}")
             else:
-                print(f"no checkpoint found in {workspace}; "
-                      "starting from scratch")
+                log(f"no checkpoint found in {workspace}; "
+                    "starting from scratch")
         params, opt_state, history = trainer.run(
             params, opt_state, make_train_iter(),
             test_iter_factory=test_factory,
@@ -429,8 +496,8 @@ def _run(args) -> int:
             scan_chunk=args.scan_chunk, feeder=feeder_flag,
             feeder_depth=args.feeder_depth)
     final = trainer.perf.to_string()
-    print("training done" + (f": {final}" if final else
-                             f" at step {model.train_steps}"))
+    log("training done" + (f": {final}" if final else
+                           f" at step {model.train_steps}"))
     return 0
 
 

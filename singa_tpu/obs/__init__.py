@@ -1,0 +1,219 @@
+"""Unified observability: span tracing, a metrics registry, a
+structured JSONL event log, and component loggers — one layer across
+training (Supervisor/Trainer/feeder/checkpoints) and serving
+(batcher/engine/server).
+
+The reference's only telemetry was the per-phase timer report
+(worker.h:91-114); this package is the cross-cutting read surface the
+ROADMAP's remaining items (fleet router health, canary promotion,
+pipeline mode) consume.  Four rules:
+
+  1. **~zero cost off.**  `obs.span(...)` / `obs.emit_event(...)` are
+     one module-global read when no session is active — the same
+     discipline as `faults.maybe_fault`.  Instrumented hot paths pay
+     nothing until `--obs on`.
+  2. **telemetry never kills work.**  Every record/write path consults
+     the `obs.emit` fault site and swallows ALL failures into drop
+     counters (`tests/test_obs.py` proves a faulted emit still
+     completes the step / the request).
+  3. **existing surfaces keep their semantics.**  `TimerInfo`,
+     `PipelineStats`, `ServeStats`, `HealthMonitor` register into the
+     `MetricsRegistry` through additive `register_into` collectors —
+     their own APIs and snapshots are unchanged.
+  4. **correlation across tiers.**  Spans inherit their parent's
+     correlation id on the same thread; cross-thread hand-offs pass
+     `obs.current_corr()` explicitly.  A request flows
+     req→batch→engine; a recovery flows attempt→restore→chunks.
+
+CLI: `--obs on|off` plus `--obs_spec 'trace=path,events=path,
+metrics_period_s=5'` (main.py), mirroring `--health_spec`.  Artifacts:
+a Chrome trace JSON (Perfetto-loadable next to `utils/profiler`
+device traces) and a JSONL event log.  See docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, fields
+from typing import Any, Callable, Dict, Optional
+
+from .log import EventLog, Logger, MetricsDumper
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      Sample, parse_prometheus)
+from .trace import NULL_HANDLE, NULL_SPAN, Tracer
+
+__all__ = [
+    "ObsSpec", "Observability", "enable", "disable", "active",
+    "session", "span", "current_corr", "emit_event", "get_logger",
+    "registry", "Tracer", "MetricsRegistry", "Counter", "Gauge",
+    "Histogram", "Sample", "EventLog", "Logger", "parse_prometheus",
+]
+
+
+@dataclass
+class ObsSpec:
+    """`--obs_spec` grammar: comma/semicolon-separated `key=value`
+    entries over these fields (the `--health_spec` convention).  Empty
+    `trace`/`events` paths disable that exporter; main.py defaults
+    both under `<workspace>/obs/` when `--obs on` is given bare."""
+    trace: str = ""             # Chrome trace JSON output path
+    events: str = ""            # JSONL event log output path
+    metrics_period_s: float = 0.0   # >0: periodic metrics → event log
+    max_spans: int = 200_000    # in-memory span buffer bound
+
+    _INT = ("max_spans",)
+    _STR = ("trace", "events")
+
+    @classmethod
+    def parse(cls, spec: Optional[str]) -> "ObsSpec":
+        out = cls()
+        if not spec:
+            return out
+        known = {f.name for f in fields(cls)
+                 if not f.name.startswith("_")}
+        for part in spec.replace(";", ",").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, sep, val = part.partition("=")
+            key = key.strip()
+            if not sep or key not in known:
+                raise ValueError(
+                    f"bad obs spec entry {part!r} (want key=value "
+                    f"with key in {sorted(known)})")
+            val = val.strip()
+            try:
+                if key in cls._STR:
+                    setattr(out, key, val)
+                elif key in cls._INT:
+                    setattr(out, key, int(val))
+                else:
+                    setattr(out, key, float(val))
+            except ValueError as e:
+                raise ValueError(
+                    f"bad obs spec value for {key!r}: {val!r}") from e
+        return out
+
+
+class Observability:
+    """One live session: a tracer, a metrics registry, an optional
+    event log, and the periodic metrics dumper.  Built by `enable`,
+    torn down (trace exported, log closed) by `disable`."""
+
+    def __init__(self, spec: Optional[ObsSpec] = None):
+        self.spec = spec or ObsSpec()
+        self.tracer = Tracer(max_spans=self.spec.max_spans)
+        self.registry = MetricsRegistry()
+        self.events: Optional[EventLog] = (
+            EventLog(self.spec.events) if self.spec.events else None)
+        self._dumper: Optional[MetricsDumper] = (
+            MetricsDumper(self.registry, self.events,
+                          self.spec.metrics_period_s)
+            if self.events is not None
+            and self.spec.metrics_period_s > 0 else None)
+
+    def flush(self) -> None:
+        """Export the trace, final-dump metrics, close the event
+        log.  Safe to call more than once; never raises."""
+        try:
+            if self._dumper is not None:
+                self._dumper.stop(final_dump=True)
+                self._dumper = None
+            if self.spec.trace:
+                self.tracer.export(self.spec.trace)
+            if self.events is not None:
+                self.events.emit(
+                    "obs.flush",
+                    spans=len(self.tracer.events()),
+                    spans_dropped=self.tracer.dropped,
+                    events_dropped=self.events.dropped)
+                self.events.close()
+        except Exception:  # noqa: BLE001 — teardown never raises
+            pass
+
+
+_LOCK = threading.Lock()
+_ACTIVE: Optional[Observability] = None
+
+
+def enable(spec: Optional[ObsSpec] = None) -> Observability:
+    """Install a process-global session (replacing — and flushing —
+    any previous one).  Returns it."""
+    global _ACTIVE
+    with _LOCK:
+        prev, _ACTIVE = _ACTIVE, Observability(spec)
+    if prev is not None:
+        prev.flush()
+    return _ACTIVE
+
+
+def disable() -> None:
+    """Flush and remove the active session.  No-op when off."""
+    global _ACTIVE
+    with _LOCK:
+        prev, _ACTIVE = _ACTIVE, None
+    if prev is not None:
+        prev.flush()
+
+
+def active() -> Optional[Observability]:
+    return _ACTIVE
+
+
+class session:
+    """`with obs.session(spec): ...` — enable for the body, flush on
+    exit (tests and bench legs)."""
+
+    def __init__(self, spec: Optional[ObsSpec] = None):
+        self._spec = spec
+
+    def __enter__(self) -> Observability:
+        return enable(self._spec)
+
+    def __exit__(self, *exc) -> bool:
+        disable()
+        return False
+
+
+# -- the instrumented-site API (hot-path: one global read when off) ---------
+
+def span(name: str, corr: Optional[str] = None, **attrs):
+    """Open a trace span, or the shared null span when off."""
+    o = _ACTIVE
+    if o is None:
+        return NULL_SPAN
+    return o.tracer.span(name, corr=corr, **attrs)
+
+
+def current_corr() -> Optional[str]:
+    """Correlation id of the innermost open span on this thread (for
+    explicit cross-thread hand-off), or None."""
+    o = _ACTIVE
+    if o is None:
+        return None
+    return o.tracer.current_corr()
+
+
+def emit_event(kind: str, **fields) -> None:
+    """Append a structured event to the active session's JSONL log.
+    No-op when off or when the session has no events path; any
+    failure is swallowed into the log's drop counter."""
+    o = _ACTIVE
+    if o is not None and o.events is not None:
+        o.events.emit(kind, **fields)
+
+
+def registry() -> Optional[MetricsRegistry]:
+    """The active session's metrics registry, or None when off."""
+    o = _ACTIVE
+    return o.registry if o is not None else None
+
+
+def get_logger(component: str,
+               sink: Optional[Callable[..., None]] = None) -> Logger:
+    """A component logger usable anywhere a bare `log_fn` is —
+    resolves the active event log per call, so it mirrors warning+
+    records whenever a session is live."""
+    return Logger(component, sink=sink,
+                  event_log_for=lambda: (
+                      _ACTIVE.events if _ACTIVE is not None else None))

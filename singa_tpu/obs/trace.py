@@ -1,0 +1,240 @@
+"""Span tracer: thread-safe, ~zero-cost-when-off context-manager
+spans exporting Chrome trace-event JSON.
+
+Design targets (docs/OBSERVABILITY.md):
+
+  * **~zero cost off** — instrumented code calls `obs.span(name)`,
+    which is one module-global read plus returning a shared null
+    context manager when no observability session is active (the same
+    discipline as `faults.maybe_fault`).
+  * **parenting** — each thread keeps a span stack; a new span's
+    parent is the innermost open span on the SAME thread, recorded as
+    `args.parent_id`.  Perfetto additionally nests by timestamp within
+    a (pid, tid) track, so the exported JSON reads as a flame chart
+    with no extra work.
+  * **correlation ids** — a span either carries an explicit `corr`
+    (e.g. `req-3`, `batch-7`, `attempt-2`) or inherits its parent's.
+    Cross-thread flows (DeviceFeeder staging, HTTP handler → dispatch
+    thread) pass the corr value explicitly; `current_corr()` reads the
+    innermost corr on the calling thread for exactly that hand-off.
+  * **telemetry never kills work** — recording a finished span
+    consults the `obs.emit` fault site and swallows *any* failure into
+    a `dropped` counter; the traced code path sees nothing.
+
+Export format: `{"traceEvents": [...], "displayTimeUnit": "ms"}` with
+`ph: "X"` complete events (ts/dur in microseconds) plus `ph: "M"`
+thread-name metadata — the same trace-event schema
+`utils/profiler.parse_trace_ops` consumes from device traces, so both
+files load side by side in Perfetto / chrome://tracing.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils import faults
+
+
+class SpanHandle:
+    """The object a `with obs.span(...) as sp` body sees: carries the
+    resolved correlation id and lets the body attach attributes that
+    end up in the exported event's `args`."""
+
+    __slots__ = ("name", "span_id", "parent_id", "corr", "attrs", "_t0")
+
+    def __init__(self, name: str, span_id: int, parent_id: int,
+                 corr: Optional[str], attrs: Dict[str, Any], t0: float):
+        self.name = name
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.corr = corr
+        self.attrs = attrs
+        self._t0 = t0
+
+    def set(self, **kw) -> None:
+        self.attrs.update(kw)
+
+
+class _NullHandle:
+    """Shared no-op handle when tracing is off."""
+    __slots__ = ()
+    name = ""
+    span_id = 0
+    parent_id = 0
+    corr = None
+
+    def set(self, **kw) -> None:
+        pass
+
+
+NULL_HANDLE = _NullHandle()
+
+
+class NullSpan:
+    """Shared no-op context manager: the entire off-path cost of an
+    instrumented site is one global read plus entering this."""
+    __slots__ = ()
+
+    def __enter__(self) -> _NullHandle:
+        return NULL_HANDLE
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+NULL_SPAN = NullSpan()
+
+
+class _SpanCtx:
+    """One live span.  Class-based (not @contextmanager) to keep the
+    on-path overhead at a couple of attribute stores; exceptions in
+    the body propagate untouched — the span still records."""
+
+    __slots__ = ("_tracer", "_handle")
+
+    def __init__(self, tracer: "Tracer", handle: SpanHandle):
+        self._tracer = tracer
+        self._handle = handle
+
+    def __enter__(self) -> SpanHandle:
+        self._tracer._push(self._handle)
+        return self._handle
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        h = self._handle
+        dur = time.perf_counter() - h._t0
+        self._tracer._pop()
+        if exc_type is not None:
+            h.attrs.setdefault("error", exc_type.__name__)
+        self._tracer._record(h, dur)
+        return False
+
+
+class Tracer:
+    """Thread-safe span recorder; see module docstring.
+
+    `max_spans` bounds the in-memory buffer — spans past it are
+    dropped (counted), never an error.  `export(path)` writes the
+    Chrome trace JSON; `events()` returns the raw event dicts for
+    tests and in-process consumers."""
+
+    def __init__(self, max_spans: int = 200_000):
+        self.max_spans = max(int(max_spans), 1)
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        self._ids = itertools.count(1)
+        self._local = threading.local()
+        self._threads_seen: Dict[int, str] = {}
+        # perf_counter origin for this tracer: ts values are relative
+        # microseconds, which is all Perfetto needs for one file
+        self._origin = time.perf_counter()
+
+    # -- thread-local span stack --------------------------------------------
+    def _stack(self) -> List[SpanHandle]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def _push(self, h: SpanHandle) -> None:
+        self._stack().append(h)
+
+    def _pop(self) -> None:
+        st = self._stack()
+        if st:
+            st.pop()
+
+    def current(self) -> Optional[SpanHandle]:
+        """Innermost open span on the calling thread, or None."""
+        st = getattr(self._local, "stack", None)
+        return st[-1] if st else None
+
+    def current_corr(self) -> Optional[str]:
+        cur = self.current()
+        return cur.corr if cur is not None else None
+
+    # -- span creation ------------------------------------------------------
+    def span(self, name: str, corr: Optional[str] = None,
+             **attrs) -> _SpanCtx:
+        """Open a span.  `corr` defaults to the parent span's
+        correlation id (same thread); extra keyword args become
+        exported `args`."""
+        parent = self.current()
+        if parent is not None:
+            parent_id = parent.span_id
+            if corr is None:
+                corr = parent.corr
+        else:
+            parent_id = 0
+        handle = SpanHandle(name, next(self._ids), parent_id, corr,
+                            attrs, time.perf_counter())
+        return _SpanCtx(self, handle)
+
+    # -- recording ----------------------------------------------------------
+    def _record(self, h: SpanHandle, dur_s: float) -> None:
+        try:
+            faults.maybe_fault("obs.emit")
+            tid = threading.get_ident()
+            args: Dict[str, Any] = {"span_id": h.span_id}
+            if h.parent_id:
+                args["parent_id"] = h.parent_id
+            if h.corr is not None:
+                args["corr"] = h.corr
+            for k, v in h.attrs.items():
+                args[k] = v if isinstance(v, (int, float, str, bool,
+                                              type(None))) else str(v)
+            ev = {"ph": "X", "cat": "obs", "name": h.name,
+                  "pid": os.getpid(), "tid": tid,
+                  "ts": round((h._t0 - self._origin) * 1e6, 3),
+                  "dur": round(dur_s * 1e6, 3),
+                  "args": args}
+            with self._lock:
+                if len(self._events) >= self.max_spans:
+                    self.dropped += 1
+                    return
+                self._events.append(ev)
+                if tid not in self._threads_seen:
+                    self._threads_seen[tid] = \
+                        threading.current_thread().name
+        except Exception:  # noqa: BLE001 — telemetry never kills work
+            self.dropped += 1
+
+    # -- reads / export -----------------------------------------------------
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def trace_dict(self) -> Dict[str, Any]:
+        """The full Chrome trace object (span events + thread-name
+        metadata), ready for json.dump."""
+        with self._lock:
+            events = list(self._events)
+            threads = dict(self._threads_seen)
+        pid = os.getpid()
+        meta = [{"ph": "M", "pid": pid, "tid": tid,
+                 "name": "thread_name", "args": {"name": tname}}
+                for tid, tname in sorted(threads.items())]
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+    def export(self, path: str) -> bool:
+        """Write the Chrome trace JSON to `path` (parent dirs
+        created).  Returns False (and counts a drop) on any failure —
+        a full disk must not fail a training run at exit."""
+        try:
+            faults.maybe_fault("obs.emit")
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self.trace_dict(), f)
+            os.replace(tmp, path)
+            return True
+        except Exception:  # noqa: BLE001
+            self.dropped += 1
+            return False

@@ -28,6 +28,7 @@ tear a batch (see engine.py).
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from collections import deque
@@ -36,6 +37,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import obs
 from ..utils import faults
 from .engine import InferenceEngine
 from .stats import ServeStats
@@ -107,6 +109,12 @@ class MicroBatcher:
             faults.Backoff(base=0.05, cap=2.0, seed=self.spec.seed)
         self._q: deque = deque()
         self._cv = threading.Condition()
+        # correlation ids: req-N assigned at admission, batch-M at
+        # dispatch; the dispatch span lists its requests' corrs, and
+        # engine spans open inside it — request→batch→engine is one
+        # traceable flow (docs/OBSERVABILITY.md)
+        self._req_ids = itertools.count(1)
+        self._batch_ids = itertools.count(1)
         self._sheds_in_a_row = 0
         self._stop = False
         self._thread: Optional[threading.Thread] = None
@@ -158,34 +166,41 @@ class MicroBatcher:
         if timeout is None:
             timeout = self.spec.request_timeout_s
         now = time.monotonic()
+        corr = f"req-{next(self._req_ids)}"
         req = _Request(tokens=arr, plen=int(arr.size), mode=mode,
                        ticket=Ticket(), t_submit=now,
-                       deadline=(now + timeout) if timeout > 0 else None)
-        try:
-            faults.maybe_fault("serve.admit")
-        except faults.FaultError as e:
-            return self._shed(f"admission fault: {e}")
-        with self._cv:
-            if self._stop:
-                raise RuntimeError("batcher is stopped")
-            if len(self._q) >= self.spec.queue_capacity:
-                pass  # shed outside the lock's happy path below
-            else:
-                self._q.append(req)
-                self._sheds_in_a_row = 0
-                self.stats.count("submitted")
-                self.stats.gauge("queue_depth", len(self._q))
-                self._cv.notify()
-                return req.ticket
-        return self._shed(
-            f"queue full ({self.spec.queue_capacity} requests)")
+                       deadline=(now + timeout) if timeout > 0 else None,
+                       extra={"corr": corr})
+        with obs.span("batcher.admit", corr=corr, mode=mode,
+                      plen=int(arr.size)):
+            try:
+                faults.maybe_fault("serve.admit")
+            except faults.FaultError as e:
+                return self._shed(f"admission fault: {e}", corr=corr)
+            with self._cv:
+                if self._stop:
+                    raise RuntimeError("batcher is stopped")
+                if len(self._q) >= self.spec.queue_capacity:
+                    pass  # shed outside the lock's happy path below
+                else:
+                    self._q.append(req)
+                    self._sheds_in_a_row = 0
+                    self.stats.count("submitted")
+                    self.stats.gauge("queue_depth", len(self._q))
+                    self._cv.notify()
+                    return req.ticket
+            return self._shed(
+                f"queue full ({self.spec.queue_capacity} requests)",
+                corr=corr)
 
-    def _shed(self, why: str) -> "Ticket":
+    def _shed(self, why: str, corr: Optional[str] = None) -> "Ticket":
         with self._cv:
             self._sheds_in_a_row += 1
             attempt = self._sheds_in_a_row
         self.stats.count("shed")
         retry = self._backoff.delay(attempt - 1)
+        obs.emit_event("serve.shed", why=why, corr=corr,
+                       retry_after=round(retry, 4))
         raise Overloaded(f"request shed ({why}); retry after "
                          f"{retry:.3f}s", retry_after=retry)
 
@@ -249,6 +264,14 @@ class MicroBatcher:
 
     def _dispatch(self, reqs: List[_Request],
                   bucket: Tuple[int, int]) -> None:
+        b, p = bucket
+        corr = f"batch-{next(self._batch_ids)}"
+        with obs.span("batcher.dispatch", corr=corr, batch=b, plen=p,
+                      reqs=[r.extra.get("corr") for r in reqs]):
+            self._dispatch_batch(reqs, bucket)
+
+    def _dispatch_batch(self, reqs: List[_Request],
+                        bucket: Tuple[int, int]) -> None:
         b, p = bucket
         try:
             faults.maybe_fault("serve.batch")

@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..models.generate import _sample, forward_cached, init_cache
 from ..utils import faults
 from ..utils.checkpoint import CheckpointManager
@@ -234,6 +235,15 @@ class InferenceEngine:
         serving)."""
         if self.ckpt is None:
             return "unchanged"
+        with obs.span("engine.reload") as sp:
+            outcome = self._poll_reload()
+            sp.set(outcome=outcome, step=self.params_step)
+        if outcome != "unchanged":
+            obs.emit_event("serve.reload", outcome=outcome,
+                           step=self.params_step)
+        return outcome
+
+    def _poll_reload(self) -> str:
         try:
             faults.maybe_fault("serve.reload")
             fp = self.ckpt.fingerprint()
@@ -334,19 +344,23 @@ class InferenceEngine:
             if mode not in MODES:
                 raise ValueError(f"unknown mode {mode!r}; modes are "
                                  f"{MODES}")
-            p_spec = jax.tree_util.tree_map(
-                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
-                self._params)
-            tok = jax.ShapeDtypeStruct((batch, prompt_len), jnp.int32)
-            pl = jax.ShapeDtypeStruct((batch,), jnp.int32)
-            if mode == "generate":
-                fn = self._build_generate(batch, prompt_len)
-                rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
-                compiled = jax.jit(fn).lower(p_spec, tok, pl,
-                                             rng).compile()
-            else:
-                fn = self._build_predict(batch, prompt_len)
-                compiled = jax.jit(fn).lower(p_spec, tok, pl).compile()
+            with obs.span("engine.compile", mode=mode, batch=batch,
+                          plen=prompt_len):
+                p_spec = jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                    self._params)
+                tok = jax.ShapeDtypeStruct((batch, prompt_len),
+                                           jnp.int32)
+                pl = jax.ShapeDtypeStruct((batch,), jnp.int32)
+                if mode == "generate":
+                    fn = self._build_generate(batch, prompt_len)
+                    rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+                    compiled = jax.jit(fn).lower(p_spec, tok, pl,
+                                                 rng).compile()
+                else:
+                    fn = self._build_predict(batch, prompt_len)
+                    compiled = jax.jit(fn).lower(p_spec, tok,
+                                                 pl).compile()
             self.stats.count("compiles")
             self._compiled[key] = compiled
             return compiled
@@ -383,11 +397,14 @@ class InferenceEngine:
         if params is None:
             params = self._params
         b, p = tokens.shape
-        compiled = self._compile(mode, b, p)
-        tokens = jnp.asarray(tokens, jnp.int32)
-        plens = jnp.asarray(plens, jnp.int32)
-        if mode == "generate":
-            out = compiled(params, tokens, plens, self._next_key())
-        else:
-            out = compiled(params, tokens, plens)
+        # on the dispatch thread this nests under batcher.dispatch and
+        # inherits its batch-M correlation id
+        with obs.span("engine.run_batch", mode=mode, batch=b, plen=p):
+            compiled = self._compile(mode, b, p)
+            tokens = jnp.asarray(tokens, jnp.int32)
+            plens = jnp.asarray(plens, jnp.int32)
+            if mode == "generate":
+                out = compiled(params, tokens, plens, self._next_key())
+            else:
+                out = compiled(params, tokens, plens)
         return np.asarray(out)

@@ -16,6 +16,10 @@ Endpoints:
     POST /predict   {"tokens": [ints], "timeout": s?}   -> {"logprobs",
                     "step", "bucket", "latency_ms"}
     GET  /stats     ServeStats.snapshot() incl. served params step
+    GET  /metrics   Prometheus text exposition of the same counters
+                    (each server owns a MetricsRegistry; the collector
+                    reads ServeStats.snapshot(), so /metrics and /stats
+                    agree by construction)
     GET  /healthz   {"ok": true, "step": n}
 Status mapping: 503 + Retry-After on `Overloaded` (shed), 504 on
 deadline/timeout, 400 on a malformed request, 500 on a failed batch.
@@ -35,6 +39,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from ..obs.metrics import MetricsRegistry
 from .batcher import DeadlineExpired, MicroBatcher, Overloaded
 from .engine import InferenceEngine, ServeSpec  # noqa: F401 (re-export)
 from .stats import ServeStats  # noqa: F401 (re-export: stats mold)
@@ -54,6 +59,10 @@ class InferenceServer:
         self.stats = engine.stats
         self.batcher = MicroBatcher(engine, log_fn=log_fn)
         self.log = log_fn
+        # per-server registry (not process-global: parallel tests each
+        # get their own) backing the /metrics Prometheus endpoint
+        self.metrics = MetricsRegistry()
+        self.stats.register_into(self.metrics)
         self._host, self._port = host, port
         self._http_wanted = http
         self._warmup_modes = tuple(warmup_modes)
@@ -171,9 +180,21 @@ def _make_handler(server: InferenceServer):
             self.end_headers()
             self.wfile.write(body)
 
+        def _reply_text(self, code: int, text: str,
+                        ctype: str = "text/plain; version=0.0.4; "
+                                     "charset=utf-8") -> None:
+            body = text.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_GET(self):
             if self.path == "/stats":
                 self._reply(200, server.snapshot())
+            elif self.path == "/metrics":
+                self._reply_text(200, server.metrics.render_prometheus())
             elif self.path == "/healthz":
                 self._reply(200, {"ok": True,
                                   "step": server.engine.params_step})

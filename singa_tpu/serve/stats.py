@@ -13,9 +13,16 @@ handler, the bench smoke, and tests all see the same semantics:
   * `occupancy` is real requests / bucket batch slots averaged over
     dispatched micro-batches (1.0 = every padded slot carried a real
     request);
-  * `qps` is completed requests over the stats object's lifetime;
+  * `qps` is completed requests over the stats object's lifetime
+    (decays on an idle server — a health dashboard should read
+    `qps_recent`, completions within the last `qps_window_s` seconds,
+    next to `uptime_s`);
   * `compiles` counts engine program compilations — a warmed server
     must hold this constant (the zero-recompile acceptance gate).
+
+`register_into(registry)` additionally exposes every snapshot field
+through an `obs.MetricsRegistry` pull-time collector (the /metrics
+Prometheus endpoint) without changing any of the above.
 """
 
 from __future__ import annotations
@@ -29,10 +36,16 @@ from typing import Any, Dict, Optional
 class ServeStats:
     """Thread-safe serving counters.  See module docstring."""
 
-    def __init__(self, latency_window: int = 2048):
+    def __init__(self, latency_window: int = 2048,
+                 qps_window_s: float = 30.0):
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
         self._latencies: deque = deque(maxlen=max(int(latency_window), 1))
+        # completion timestamps for the windowed QPS (bounded: at most
+        # latency_window recent completions contribute)
+        self.qps_window_s = max(float(qps_window_s), 0.001)
+        self._completions: deque = deque(
+            maxlen=max(int(latency_window), 1))
         # admission / completion
         self.submitted = 0
         self.completed = 0
@@ -57,6 +70,10 @@ class ServeStats:
 
     def gauge(self, field: str, value: int) -> None:
         with self._lock:
+            # a typo'd field must fail loudly (AttributeError), not
+            # silently create a new attribute no snapshot ever reads —
+            # the same implicit validation count()'s getattr performs
+            getattr(self, field)
             setattr(self, field, value)
 
     def observe_batch(self, requests: int, slots: int) -> None:
@@ -69,6 +86,7 @@ class ServeStats:
         with self._lock:
             self.completed += 1
             self._latencies.append(seconds)
+            self._completions.append(time.monotonic())
 
     # -- reads -------------------------------------------------------------
     def latency_quantile(self, q: float) -> Optional[float]:
@@ -92,6 +110,50 @@ class ServeStats:
             dt = time.monotonic() - self._t0
             return self.completed / dt if dt > 0 else 0.0
 
+    def uptime_s(self) -> float:
+        return time.monotonic() - self._t0
+
+    def qps_recent(self) -> float:
+        """Completions within the last `qps_window_s` seconds over
+        that window (capped at uptime while the server is younger than
+        the window) — 0.0 the moment traffic stops, where the lifetime
+        `qps` only decays asymptotically."""
+        now = time.monotonic()
+        with self._lock:
+            window = min(self.qps_window_s, max(now - self._t0, 1e-6))
+            cutoff = now - window
+            n = sum(1 for t in self._completions if t >= cutoff)
+        return n / window
+
+    def register_into(self, registry,
+                      prefix: str = "singa_serve") -> None:
+        """Register every snapshot field into an `obs.MetricsRegistry`
+        as a pull-time collector (counters for the monotonic tallies,
+        gauges for the derived/point-in-time values) — additive;
+        snapshot() semantics are untouched, so /metrics and /stats
+        agree by construction."""
+        from ..obs.metrics import Sample
+
+        counters = ("submitted", "completed", "failed", "expired",
+                    "shed", "batches", "batched_requests",
+                    "batch_slots", "compiles", "reloads",
+                    "reload_failures", "reloads_refused")
+        gauges = ("queue_depth", "qps", "qps_recent", "uptime_s",
+                  "p50_latency_ms", "p95_latency_ms",
+                  "batch_occupancy")
+
+        def collect():
+            snap = self.snapshot()
+            out = [Sample(f"{prefix}_{k}_total", "counter",
+                          f"serving counter {k!r}", float(snap[k]))
+                   for k in counters]
+            out += [Sample(f"{prefix}_{k}", "gauge",
+                           f"serving gauge {k!r}", float(snap[k]))
+                    for k in gauges if snap.get(k) is not None]
+            return out
+
+        registry.register_collector(collect)
+
     def snapshot(self) -> Dict[str, Any]:
         """JSON-ready view for /stats and BENCH_pr5.json."""
         p50, p95 = (self.latency_quantile(0.50),
@@ -114,6 +176,8 @@ class ServeStats:
                 "reloads_refused": self.reloads_refused,
             }
         out["qps"] = round(self.qps(), 3)
+        out["qps_recent"] = round(self.qps_recent(), 3)
+        out["uptime_s"] = round(self.uptime_s(), 3)
         out["p50_latency_ms"] = (round(p50 * 1e3, 3)
                                  if p50 is not None else None)
         out["p95_latency_ms"] = (round(p95 * 1e3, 3)

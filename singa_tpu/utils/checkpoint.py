@@ -31,6 +31,7 @@ import jax
 import numpy as np
 
 from . import faults
+from .. import obs
 
 try:
     import orbax.checkpoint as ocp
@@ -200,6 +201,13 @@ class CheckpointManager:
         HealthMonitor.snapshot_health) is recorded in MANIFEST.json so
         `restore(skip_unhealthy=True)` can walk back past snapshots
         taken in a numerically suspect window."""
+        with obs.span("ckpt.save", step=step,
+                      verdict=(health or {}).get("verdict")):
+            self._save(step, params, opt_state, health)
+
+    def _save(self, step: int, params: Dict[str, Any],
+              opt_state: Dict[str, Any],
+              health: Optional[Dict[str, Any]] = None) -> None:
         if self.latest_step() is not None:
             # never mix layouts in one directory: saving v-current into
             # a workspace still holding older-layout checkpoints would
@@ -292,6 +300,17 @@ class CheckpointManager:
         snapshot, not just the last readable one — the rollback the
         Supervisor's divergence rescue relies on.  Snapshots with no
         health record (saved without a monitor) count as ok."""
+        with obs.span("ckpt.restore",
+                      skip_unhealthy=skip_unhealthy) as sp:
+            out = self._restore(step, template, skip_unhealthy)
+            if out is not None:
+                sp.set(step=out[2])
+            return out
+
+    def _restore(self, step: Optional[int],
+                 template: Optional[Dict[str, Any]],
+                 skip_unhealthy: bool
+                 ) -> Optional[Tuple[Dict, Dict, int]]:
         steps = self.available_steps()
         if step is not None:
             steps = [s for s in steps if s <= step]

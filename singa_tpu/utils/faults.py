@@ -41,6 +41,11 @@ restart — so a one-shot fault never re-fires during recovery):
     serve.reload   one checkpoint hot-reload attempt
                    (InferenceEngine.poll_reload — an error degrades to
                    keep-serving-old-params, counted in ServeStats)
+    obs.emit       one telemetry record written (a span recorded, an
+                   event-log line appended, a trace exported — every
+                   obs write path swallows the fault into a drop
+                   counter, proving telemetry failure never takes
+                   down training or serving)
 
 Fault kinds:
 
@@ -75,7 +80,8 @@ from typing import Dict, List, Optional
 
 SITES = ("data.decode", "data.prefetch", "feed.stage", "ckpt.save",
          "ckpt.restore", "sync.elastic", "sync.delta", "step.train",
-         "step.grad", "serve.admit", "serve.batch", "serve.reload")
+         "step.grad", "serve.admit", "serve.batch", "serve.reload",
+         "obs.emit")
 
 KINDS = ("error", "preempt", "corrupt", "torn", "nan", "spike")
 

@@ -246,6 +246,23 @@ class HealthMonitor:
         self._since_snapshot = OK
         self._last_vals: Dict[str, float] = {}
 
+    def register_into(self, registry,
+                      prefix: str = "singa_health") -> None:
+        """Register the verdict tallies into an `obs.MetricsRegistry`
+        as a pull-time collector — additive; classification semantics
+        and the monitor's own API are untouched.  (Counts reset per
+        Supervisor attempt, exactly like `self.counts` always has.)"""
+        from ..obs.metrics import Sample
+
+        def collect():
+            return [Sample(f"{prefix}_verdict_{status}_total",
+                           "counter",
+                           f"steps classified {status.upper()} "
+                           f"(current attempt)", float(n))
+                    for status, n in sorted(self.counts.items())]
+
+        registry.register_collector(collect)
+
     # -- classification ----------------------------------------------------
     @staticmethod
     def _extract(metrics: Dict[str, Any]) -> Dict[str, float]:
