@@ -506,6 +506,202 @@ def bench_serve_smoke(n_clients=6, reqs_per_client=5, out=None):
     return result
 
 
+def bench_fleet_smoke(n_clients=6, reqs_per_client=6, out=None):
+    """Fleet smoke (ISSUE 7 acceptance): a 3-engine fleet behind the
+    router + FleetServer sustains concurrent HTTP traffic on CPU, and
+    the run FAILS (raises) unless:
+      * killing 1 of 3 engines mid-load costs ZERO client-visible
+        failures — every request either retries onto a healthy sibling
+        or sheds with 503 + Retry-After (clients honor it); never a
+        500, never a hang.  The dead engine is quarantined and, once
+        revived, readmitted (kill->readmission time is recorded);
+      * a DIVERGED checkpoint save is canaried on exactly one engine
+        and auto-rolled back — at no point do >=2 engines serve the
+        bad fingerprint, and the fleet ends on the old step;
+      * a healthy save afterwards promotes fleet-wide (every engine on
+        the new step).
+    Records fleet p50/p95, kill-recovery time, and rollout outcome
+    counts; `out` writes the JSON line to a file as well
+    (scripts/fleet_smoke.sh -> BENCH_pr7.json)."""
+    import json as _json
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import jax
+
+    from singa_tpu.core.net import build_net
+    from singa_tpu.models.transformer import transformer_lm
+    from singa_tpu.serve import (EngineFleet, FleetServer, RolloutSpec,
+                                 RouterSpec, ServeSpec)
+    from singa_tpu.utils.checkpoint import CheckpointManager
+
+    vocab, seq = 64, 16
+    cfg = transformer_lm(vocab_size=vocab, num_layers=2, embed_dim=32,
+                         num_heads=4, head_dim=8, seq_len=seq,
+                         batchsize=2)
+    net = build_net(cfg, "kTest",
+                    {"data": {"input": (seq,), "target": (seq,)}})
+    params = net.init_params(jax.random.PRNGKey(0))
+    opt = {"t": np.zeros(())}
+
+    ws = tempfile.mkdtemp(prefix="fleet_smoke_")
+    mgr = CheckpointManager(ws, max_to_keep=10, log_fn=lambda s: None)
+    mgr.save(1, params, opt, health={"verdict": "ok"})
+
+    spec = ServeSpec(buckets=((2, 8), (4, 16)), max_new_tokens=6,
+                     batch_window_s=0.005, request_timeout_s=30.0)
+    fleet = EngineFleet.local(
+        net, spec, 3, workspace=ws, params=params,
+        router_spec=RouterSpec(probe_period_s=0.05,
+                               quarantine_after=1,
+                               readmit_base_s=0.05, readmit_cap_s=0.5),
+        rollout_spec=RolloutSpec(poll_s=0.05, window_s=0.2),
+        log_fn=lambda s: None)
+    fleet.start()
+    front = FleetServer(fleet, port=0, log_fn=lambda s: None)
+    front.start()
+    host, port = front.address
+    url = f"http://{host}:{port}"
+
+    errors, results = [], []
+    sheds = [0]
+    stop_traffic = threading.Event()
+
+    def post_with_retry(payload):
+        # the well-behaved client: honor 503 + Retry-After, treat any
+        # other 5xx (or a hang) as a real failure
+        for _ in range(50):
+            req = urllib.request.Request(
+                f"{url}/generate", data=_json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    return _json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                if e.code == 503:
+                    sheds[0] += 1
+                    time.sleep(float(
+                        e.headers.get("Retry-After", 0.05)) or 0.05)
+                    continue
+                raise
+        raise RuntimeError("request still shed after 50 retries")
+
+    rng = np.random.default_rng(0)
+    prompts = [[rng.integers(1, vocab, rng.integers(1, 13)).tolist()
+                for _ in range(reqs_per_client)]
+               for _ in range(n_clients)]
+
+    def client(i):
+        try:
+            for p in prompts[i]:
+                results.append(post_with_retry({"tokens": p}))
+                if stop_traffic.is_set():
+                    return
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(repr(e))
+
+    # -- phase 1: kill one engine under load, measure recovery --------
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    time.sleep(0.1)                  # let traffic land on every engine
+    victim = fleet.router.healthy_names()[0]
+    handle = fleet.router.handle_for(victim)
+    t_kill = time.perf_counter()
+    handle.kill()
+    time.sleep(0.3)
+    handle.revive()
+    deadline = time.time() + 15
+    while time.time() < deadline and \
+            fleet.router.stats.readmissions == 0:
+        time.sleep(0.02)
+    kill_recovery_s = time.perf_counter() - t_kill
+    for t in threads:
+        t.join()
+
+    # -- phase 2: diverged canary -> rollback, healthy -> promote -----
+    def engine_steps():
+        return [fleet.router.handle_for(n).engine.params_step
+                for n in fleet.router.names()]
+
+    probe = np.arange(1, 6, dtype=np.int32).tolist()
+    max_on_bad = [0]
+    mgr.save(2, params, opt, health={"verdict": "diverged"})
+    deadline = time.time() + 20
+    while time.time() < deadline and fleet.rollout.rollbacks == 0:
+        max_on_bad[0] = max(max_on_bad[0],
+                            sum(1 for s in engine_steps() if s == 2))
+        post_with_retry({"tokens": probe})
+    steps_after_rollback = engine_steps()
+    mgr.save(3, params, opt, health={"verdict": "ok"})
+    deadline = time.time() + 20
+    while time.time() < deadline and fleet.rollout.promotions == 0:
+        post_with_retry({"tokens": probe})
+    time.sleep(0.1)
+    steps_after_promote = engine_steps()
+
+    with urllib.request.urlopen(f"{url}/stats", timeout=10) as r:
+        snap = _json.loads(r.read())
+    ro = fleet.rollout.snapshot()
+    front.stop()
+    fleet.stop()
+
+    n_total = n_clients * reqs_per_client
+    failures = []
+    if errors:
+        failures.append(f"client-visible failures: {errors}")
+    if len(results) != n_total:
+        failures.append(f"dropped requests: {len(results)}/{n_total}")
+    if snap["quarantines"] < 1:
+        failures.append("killed engine was never quarantined")
+    if snap["readmissions"] < 1:
+        failures.append("revived engine was never readmitted")
+    if ro["rollbacks"] != 1 or max_on_bad[0] > 1:
+        failures.append(f"diverged rollout not contained: rollbacks="
+                        f"{ro['rollbacks']}, engines on bad "
+                        f"fingerprint={max_on_bad[0]}")
+    if steps_after_rollback != [1, 1, 1]:
+        failures.append(f"fleet not restored to pinned step after "
+                        f"rollback: {steps_after_rollback}")
+    if ro["promotions"] != 1 or steps_after_promote != [3, 3, 3]:
+        failures.append(f"healthy rollout did not promote fleet-wide: "
+                        f"promotions={ro['promotions']}, steps "
+                        f"{steps_after_promote}")
+    if failures:
+        raise RuntimeError("fleet smoke FAILED: " + "; ".join(failures))
+
+    result = {
+        "metric": "fleet_smoke_p50_latency",
+        "value": snap["p50_latency_ms"],
+        "unit": "ms",
+        "p95_latency_ms": snap["p95_latency_ms"],
+        "kill_recovery_s": round(kill_recovery_s, 3),
+        "engines": 3,
+        "clients": n_clients,
+        "requests": n_total,
+        "routed": snap["routed"],
+        "completed": snap["completed"],
+        "retried": snap["retried"],
+        "shed_http_503": sheds[0],
+        "quarantines": snap["quarantines"],
+        "readmissions": snap["readmissions"],
+        "canaries": ro["canaries"],
+        "promotions": ro["promotions"],
+        "rollbacks": ro["rollbacks"],
+        "refusals": ro["refusals"],
+        "final_steps": steps_after_promote,
+        "backend": jax.default_backend(),
+    }
+    line = json.dumps(result)
+    if out:
+        with open(out, "w") as f:
+            f.write(line + "\n")
+    return result
+
+
 def bench_obs_overhead(batch_size=64, steps=96, scan_chunk=8,
                        reps=3, out=None):
     """ISSUE 6 acceptance: `--obs on` must cost < 3% wall time on the
@@ -602,6 +798,12 @@ def main() -> None:
         if "--out" in sys.argv:
             out = sys.argv[sys.argv.index("--out") + 1]
         print(json.dumps(bench_serve_smoke(out=out)))
+        return
+    if "--fleet-smoke" in sys.argv:
+        out = None
+        if "--out" in sys.argv:
+            out = sys.argv[sys.argv.index("--out") + 1]
+        print(json.dumps(bench_fleet_smoke(out=out)))
         return
     if "--obs-overhead" in sys.argv:
         out = None

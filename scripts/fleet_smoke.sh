@@ -1,0 +1,140 @@
+#!/usr/bin/env bash
+# Fleet smoke (ISSUE 7 acceptance): a 3-engine serving fleet behind
+# the health-driven router, on CPU.  FAILS unless
+#   * killing 1 of 3 engines under load costs ZERO client-visible
+#     failures (requests retry onto healthy siblings or shed with
+#     503 + Retry-After; never a 500, never a hang), the dead engine
+#     is quarantined, and the revived engine is readmitted;
+#   * a DIVERGED checkpoint is canaried on exactly one engine and
+#     auto-rolled back (never >=2 engines on the bad fingerprint), and
+#     a healthy checkpoint afterwards promotes fleet-wide.
+# Writes BENCH_pr7.json (fleet p50/p95, kill-recovery time, rollout
+# outcome counts).
+#
+# Usage: scripts/fleet_smoke.sh        (CPU-only, no data, ~3 min)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+# Leg 1: the bench smoke — in-process 3-engine fleet over real HTTP
+# (FleetServer), kill/revive mid-load, diverged-then-healthy rollout.
+# bench_fleet_smoke raises (and this script fails) unless every
+# acceptance bullet holds.
+python bench.py --fleet-smoke --out BENCH_pr7.json
+
+# the recorded artifact must actually carry the numbers, not nulls
+python - <<'EOF'
+import json
+with open("BENCH_pr7.json") as f:
+    d = json.loads(f.read())
+for k in ("value", "p95_latency_ms", "kill_recovery_s"):
+    assert isinstance(d.get(k), (int, float)), \
+        f"BENCH_pr7.json: {k} missing/null: {d.get(k)}"
+assert d["quarantines"] >= 1 and d["readmissions"] >= 1, d
+assert d["rollbacks"] == 1 and d["promotions"] == 1, d
+assert d["final_steps"] == [3, 3, 3], d
+print(f"BENCH_pr7.json ok: p50={d['value']}ms p95={d['p95_latency_ms']}ms "
+      f"kill_recovery={d['kill_recovery_s']}s rollout="
+      f"{d['canaries']}c/{d['promotions']}p/{d['rollbacks']}r")
+EOF
+echo "FLEET BENCH PASS: engine kill absorbed, diverged canary rolled"
+echo "  back on one engine, healthy checkpoint promoted fleet-wide"
+
+# Leg 2: the subprocess deployment — 3 real `serve --pinned` worker
+# processes adopted via a hostfile, SIGKILL one mid-load (a REAL
+# process death, not a simulated one), zero client-visible failures,
+# quarantine, then restart -> readmission.
+python - <<'EOF'
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+PORTS = [18471, 18472, 18473]
+SPEC = "buckets=2x8,max_new_tokens=4,batch_window_s=0.005"
+
+
+def spawn(port):
+    return subprocess.Popen(
+        [sys.executable, "-m", "singa_tpu.main", "serve",
+         "-model_conf", "examples/transformer/lm.conf",
+         "--pinned", "--port", str(port), "--serve_spec", SPEC],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def wait_ready(port, deadline_s=180):
+    deadline = time.time() + deadline_s
+    while True:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=2)
+            return
+        except Exception:
+            if time.time() > deadline:
+                raise RuntimeError(f"worker on :{port} never came up")
+            time.sleep(0.25)
+
+
+procs = {p: spawn(p) for p in PORTS}
+try:
+    for p in PORTS:
+        wait_ready(p)
+    hostfile = tempfile.NamedTemporaryFile(
+        mode="w", suffix=".hosts", delete=False)
+    hostfile.write("".join(f"127.0.0.1:{p}\n" for p in PORTS))
+    hostfile.close()
+
+    from singa_tpu.serve import EngineFleet, RouterSpec
+    fleet = EngineFleet.from_hostfile(
+        hostfile.name,
+        router_spec=RouterSpec(probe_period_s=0.1, quarantine_after=1,
+                               readmit_base_s=0.1, readmit_cap_s=1.0),
+        log_fn=lambda s: None)
+    fleet.start()
+    prompt = list(range(1, 6))
+    for _ in range(6):
+        fleet.generate(prompt)
+
+    # SIGKILL one worker process mid-load: traffic must not notice
+    victim = PORTS[0]
+    procs[victim].send_signal(signal.SIGKILL)
+    procs[victim].wait()
+    failures = 0
+    for _ in range(20):
+        try:
+            fleet.generate(prompt)
+        except Exception:  # noqa: BLE001 — counted, asserted zero
+            failures += 1
+        time.sleep(0.02)
+    assert failures == 0, f"{failures} client-visible failures after kill"
+    assert fleet.router.stats.quarantines >= 1, "no quarantine"
+
+    # restart the worker -> the router readmits it on a clean probe
+    procs[victim] = spawn(victim)
+    wait_ready(victim)
+    deadline = time.time() + 30
+    while time.time() < deadline and fleet.router.stats.readmissions == 0:
+        time.sleep(0.1)
+    assert fleet.router.stats.readmissions >= 1, "no readmission"
+    fleet.stop()
+    print(f"subprocess fleet ok: SIGKILL absorbed with 0 failures, "
+          f"quarantines={fleet.router.stats.quarantines}, "
+          f"readmissions={fleet.router.stats.readmissions}")
+finally:
+    for pr in procs.values():
+        if pr.poll() is None:
+            pr.kill()
+EOF
+echo "FLEET SUBPROCESS PASS: real worker SIGKILL absorbed, quarantine"
+echo "  + readmission over the hostfile/HTTP membership"
+
+# Leg 3: the CLI surface — `singa_tpu.main serve --fleet 3 --smoke`
+python -m singa_tpu.main serve -model_conf examples/transformer/lm.conf \
+    --fleet 3 --smoke 6 \
+    --serve_spec 'buckets=2x8,max_new_tokens=4,batch_window_s=0.005' \
+    | grep -E '"completed": 6' > /dev/null || {
+        echo "FLEET SMOKE CLI LEG FAILED"; exit 1; }
+echo "FLEET SMOKE CLI PASS"

@@ -16,8 +16,12 @@ Serving (the inference tier, singa_tpu/serve/):
         --workspace ws [--port 8000] [--serve_spec 'buckets=4x16/8x32,...']
 follows the trainer's checkpoints in the workspace (hot-reload) and
 serves /generate, /predict, /stats, /metrics, /healthz over stdlib
-HTTP.  Both subcommands take `--obs on [--obs_spec ...]` for the
-unified telemetry layer (docs/OBSERVABILITY.md).
+HTTP.  `serve --fleet N` runs N pinned engine workers behind a
+health-driven router with canary rollout/auto-rollback;
+`serve --fleet_hostfile h` adopts already-running `serve --pinned`
+processes as the fleet.  Both subcommands take `--obs on
+[--obs_spec ...]` for the unified telemetry layer
+(docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -166,9 +170,35 @@ def make_serve_argparser() -> argparse.ArgumentParser:
                     help="serve N synthetic in-process requests, "
                          "print the stats snapshot as JSON, and exit "
                          "(no HTTP listener)")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="serving fleet: spawn N in-process pinned "
+                         "engine workers behind a health-driven "
+                         "router with canary rollout/auto-rollback "
+                         "(docs/SERVING.md)")
+    ap.add_argument("--fleet_hostfile", default=None,
+                    help="adopt an already-running fleet instead of "
+                         "spawning one: one engine host[:port] per "
+                         "line (each a `serve --pinned` process); "
+                         "mutually exclusive with --fleet")
+    ap.add_argument("--fleet_spec", default=None,
+                    help="router config: comma-separated key=value "
+                         "over the RouterSpec fields, e.g. "
+                         "'probe_period_s=0.25,quarantine_after=2,"
+                         "readmit_base_s=0.25' "
+                         "(singa_tpu/serve/router.py)")
+    ap.add_argument("--rollout_spec", default=None,
+                    help="rollout config: comma-separated key=value "
+                         "over the RolloutSpec fields, e.g. "
+                         "'window_s=2,min_requests=10,p95_ratio=3' "
+                         "(singa_tpu/serve/fleet.py)")
+    ap.add_argument("--pinned", action="store_true",
+                    help="run this engine as a fleet member: never "
+                         "self-reload; only the rollout controller's "
+                         "POST /admin/reload moves the served params")
     ap.add_argument("--fault_spec", default=None,
                     help="deterministic fault injection over the "
-                         "serve.* sites (singa_tpu/utils/faults.py)")
+                         "serve.* and fleet.* sites "
+                         "(singa_tpu/utils/faults.py)")
     _add_obs_flags(ap)
     return ap
 
@@ -179,6 +209,11 @@ def serve_main(argv) -> int:
     import json as _json
 
     args = make_serve_argparser().parse_args(argv)
+    if args.fleet and args.fleet_hostfile:
+        print("error: --fleet and --fleet_hostfile are mutually "
+              "exclusive (spawn a fleet OR adopt one)",
+              file=sys.stderr)
+        return 2
     from .utils.faults import FaultSchedule, inject
     schedule = (FaultSchedule.parse(args.fault_spec, seed=args.seed)
                 if args.fault_spec else None)
@@ -201,8 +236,12 @@ def serve_main(argv) -> int:
         # fresh-init fallback so a checkpoint-less workspace still
         # serves (engine.load prefers any restorable healthy snapshot)
         fallback = net.init_params(jax.random.PRNGKey(args.seed))
+        if args.fleet or args.fleet_hostfile:
+            return _fleet_main(args, net, spec, fallback, schedule,
+                               log)
         engine = InferenceEngine(net, spec, workspace=args.workspace,
-                                 params=fallback, log_fn=log)
+                                 params=fallback, log_fn=log,
+                                 pinned=args.pinned)
         reg = obs.registry()
         if reg is not None:
             engine.stats.register_into(reg)
@@ -248,6 +287,70 @@ def serve_main(argv) -> int:
     finally:
         if obs_on:
             obs.disable()
+
+
+def _fleet_main(args, net, spec, fallback, schedule, log) -> int:
+    """The fleet branch of `serve`: N pinned engine workers behind a
+    `Router` + `RolloutController`, fronted by `FleetServer` (or
+    driven in-process under --smoke)."""
+    import json as _json
+
+    from .serve import EngineFleet, FleetServer, RolloutSpec, RouterSpec
+    from .utils.faults import inject
+
+    router_spec = RouterSpec.parse(args.fleet_spec)
+    rollout_spec = RolloutSpec.parse(args.rollout_spec)
+    if args.pinned:
+        log("warning: --pinned is a member flag; the fleet's workers "
+            "are always pinned — ignoring")
+    with inject(schedule):
+        if schedule is not None:
+            log(f"fault injection active: {args.fault_spec} "
+                f"(seed {args.seed})")
+        if args.fleet_hostfile:
+            fleet = EngineFleet.from_hostfile(
+                args.fleet_hostfile, workspace=args.workspace,
+                router_spec=router_spec, rollout_spec=rollout_spec,
+                log_fn=log)
+        else:
+            fleet = EngineFleet.local(
+                net, spec, args.fleet, workspace=args.workspace,
+                params=fallback, router_spec=router_spec,
+                rollout_spec=rollout_spec, log_fn=log)
+        reg = obs.registry()
+        if reg is not None:
+            fleet.router.stats.register_into(reg)
+        fleet.start()
+        try:
+            if args.smoke > 0:
+                import numpy as np
+                rng = np.random.default_rng(args.seed)
+                vocab = _serve_vocab(net)
+                for i in range(args.smoke):
+                    plen = int(rng.integers(1, spec.max_prompt_len + 1))
+                    prompt = rng.integers(0, vocab,
+                                          plen).astype("int32")
+                    out = fleet.generate(prompt)
+                    log(f"smoke {i}: plen={plen} -> "
+                        f"{len(out['tokens'])} tokens on "
+                        f"{out['engine']} (step {out['step']})")
+                print(_json.dumps(fleet.snapshot()))
+                return 0
+            front = FleetServer(fleet, host=args.host, port=args.port,
+                                log_fn=log)
+            front.start()
+            try:
+                import time
+                while True:
+                    time.sleep(3600)
+            except KeyboardInterrupt:
+                log("fleet: shutting down")
+                print(_json.dumps(fleet.snapshot()))
+                return 0
+            finally:
+                front.stop()
+        finally:
+            fleet.stop()
 
 
 def _serve_vocab(net) -> int:

@@ -6,24 +6,40 @@ trainer's checkpoints via atomic hot-reload.  Layers:
 
     engine.py   ServeSpec + InferenceEngine: AOT-compiled per-bucket
                 generate/predict programs, healthy-checkpoint load,
-                degrade-not-crash hot reload
+                degrade-not-crash hot reload, pinned-fingerprint fleet
+                mode + explicit reload_to, honest health() verdicts
     batcher.py  MicroBatcher: bounded-queue admission with Backoff
                 shedding, deadline expiry, smallest-admissible-bucket
                 coalescing with left-pad masking
     server.py   InferenceServer: stdlib-HTTP + in-process frontends,
-                reload poll thread
+                reload poll thread, /admin/reload command channel
     stats.py    ServeStats: QPS, p50/p95 latency, occupancy, queue
                 depth, reload/shed counters (PipelineStats mold)
+    router.py   Router + engine handles: least-loaded healthy
+                dispatch, retry-on-other-engine, Backoff quarantine /
+                readmission, router-level shedding
+    fleet.py    EngineFleet + RolloutController + FleetServer:
+                N workers behind one router, canary rollout with
+                auto-rollback (OBSERVE -> CANARY -> PROMOTE/ROLLBACK)
 
-Fault sites `serve.admit` / `serve.batch` / `serve.reload`
-(utils.faults) make every degradation path deterministic on CPU.
+Fault sites `serve.admit` / `serve.batch` / `serve.reload` /
+`fleet.dispatch` / `fleet.rollout` (utils.faults) make every
+degradation path deterministic on CPU.
 """
 
 from .batcher import DeadlineExpired, MicroBatcher, Overloaded, Ticket
 from .engine import InferenceEngine, ServeSpec
+from .fleet import (EngineFleet, FleetServer, RolloutController,
+                    RolloutSpec)
+from .router import (EngineUnavailable, HttpEngineHandle,
+                     LocalEngineHandle, Router, RouterSpec,
+                     RouterStats)
 from .server import InferenceServer
 from .stats import ServeStats
 
-__all__ = ["DeadlineExpired", "InferenceEngine", "InferenceServer",
-           "MicroBatcher", "Overloaded", "ServeSpec", "ServeStats",
+__all__ = ["DeadlineExpired", "EngineFleet", "EngineUnavailable",
+           "FleetServer", "HttpEngineHandle", "InferenceEngine",
+           "InferenceServer", "LocalEngineHandle", "MicroBatcher",
+           "Overloaded", "RolloutController", "RolloutSpec", "Router",
+           "RouterSpec", "RouterStats", "ServeSpec", "ServeStats",
            "Ticket"]

@@ -289,6 +289,9 @@ class MicroBatcher:
                                         params=params)
         except Exception as e:  # noqa: BLE001 — fail batch, keep serving
             self.stats.count("failed", len(reqs))
+            # one more strike toward the degraded /healthz verdict
+            # (reset by observe_batch on the next successful dispatch)
+            self.stats.observe_batch_failure()
             self.log(f"warning: serve batch failed "
                      f"({type(e).__name__}: {e}); {len(reqs)} "
                      f"request(s) failed, server continues")

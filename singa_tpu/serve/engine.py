@@ -68,6 +68,7 @@ class ServeSpec:
     batch_window_s: float = 0.01
     request_timeout_s: float = 5.0
     reload_poll_s: float = 1.0
+    degraded_after: int = 3   # consecutive failed batches -> degraded
     seed: int = 0
 
     def __post_init__(self):
@@ -89,6 +90,9 @@ class ServeSpec:
         if int(self.queue_capacity) < 1:
             raise ValueError(f"queue_capacity must be >= 1, got "
                              f"{self.queue_capacity}")
+        if int(self.degraded_after) < 1:
+            raise ValueError(f"degraded_after must be >= 1, got "
+                             f"{self.degraded_after}")
 
     @property
     def max_prompt_len(self) -> int:
@@ -173,7 +177,8 @@ class InferenceEngine:
     def __init__(self, net, spec: ServeSpec,
                  workspace: Optional[str] = None,
                  params: Optional[Dict[str, Any]] = None,
-                 stats: Optional[ServeStats] = None, log_fn=print):
+                 stats: Optional[ServeStats] = None, log_fn=print,
+                 pinned: bool = False):
         if workspace is None and params is None:
             raise ValueError("InferenceEngine needs a checkpoint "
                              "workspace or explicit params")
@@ -187,6 +192,16 @@ class InferenceEngine:
                         if params is not None else None)
         self.params_step: int = -1
         self._fingerprint: Optional[tuple] = None
+        # pinned-fingerprint mode (fleet members): the engine never
+        # follows the workspace on its own — poll_reload is a no-op
+        # and only an explicit reload_to (the rollout controller's
+        # command channel) moves the served params
+        self.pinned = bool(pinned)
+        # honest /healthz: a refused/failed reload leaves the engine
+        # serving STALE params; recorded here (and cleared by the next
+        # successful reload) so the router sees a degraded verdict
+        # instead of an unconditional ok
+        self._stale_reason: Optional[str] = None
         self._compiled: Dict[Tuple[str, int, int], Any] = {}
         self._compile_lock = threading.Lock()
         self._key_counter = 0
@@ -235,6 +250,9 @@ class InferenceEngine:
         serving)."""
         if self.ckpt is None:
             return "unchanged"
+        if self.pinned:
+            # fleet member: the rollout controller owns reloads
+            return "pinned"
         with obs.span("engine.reload") as sp:
             outcome = self._poll_reload()
             sp.set(outcome=outcome, step=self.params_step)
@@ -257,12 +275,17 @@ class InferenceEngine:
                 # poll tick; a future save changes it again.
                 self._fingerprint = fp
                 self.stats.count("reloads_refused")
+                self._stale_reason = (
+                    f"reload refused: newer checkpoint on disk is not "
+                    f"healthy/restorable; serving stale step "
+                    f"{self.params_step}")
                 self.log("serve: reload refused — no newer healthy "
                          f"checkpoint (serving step {self.params_step})")
                 return "refused"
             p, _, step = restored
             self._swap(p, step)
             self._fingerprint = fp
+            self._stale_reason = None
             self.stats.count("reloads")
             self.log(f"serve: hot-reloaded checkpoint step {step}")
             return "reloaded"
@@ -270,10 +293,97 @@ class InferenceEngine:
             # fingerprint deliberately NOT updated: the next poll
             # retries the same reload instead of wedging on old params
             self.stats.count("reload_failures")
+            self._stale_reason = (
+                f"reload failed ({type(e).__name__}); serving stale "
+                f"step {self.params_step}")
             self.log(f"warning: serve reload failed "
                      f"({type(e).__name__}: {e}); keeping params from "
                      f"step {self.params_step}")
             return "failed"
+
+    def reload_to(self, step: Optional[int] = None,
+                  skip_unhealthy: bool = False) -> str:
+        """Explicit reload — the fleet rollout controller's command
+        channel (works on a pinned engine; that is its point).  Loads
+        checkpoint `step` (None = latest on disk), by default WITHOUT
+        the healthy-verdict walk-back: a canary deliberately serves
+        the exact target snapshot and the rollout verdict — not the
+        manifest alone — decides its fate.  `restore` still walks back
+        past a torn/corrupt target, so the caller must verify
+        `params_step` landed where it asked.  Returns "reloaded" |
+        "unchanged" | "refused" | "failed"; never raises and never
+        unseats the live params on failure."""
+        if self.ckpt is None:
+            return "refused"
+        with obs.span("engine.reload", target=step) as sp:
+            outcome = self._reload_to(step, skip_unhealthy)
+            sp.set(outcome=outcome, step=self.params_step)
+        if outcome != "unchanged":
+            obs.emit_event("serve.reload", outcome=outcome,
+                           step=self.params_step, target=step)
+        return outcome
+
+    def _reload_to(self, step: Optional[int],
+                   skip_unhealthy: bool) -> str:
+        try:
+            faults.maybe_fault("serve.reload")
+            fp = self.ckpt.fingerprint()
+            restored = self.ckpt.restore(step=step,
+                                         skip_unhealthy=skip_unhealthy)
+            if restored is None:
+                self.stats.count("reloads_refused")
+                self._stale_reason = (
+                    f"explicit reload to step {step} found nothing "
+                    f"restorable; serving stale step {self.params_step}")
+                self.log(f"serve: explicit reload to step {step} "
+                         f"refused — nothing restorable")
+                return "refused"
+            p, _, got = restored
+            if got == self.params_step:
+                # already serving it (e.g. a rollback to the pinned
+                # step that never left it) — success, not a refusal
+                self._fingerprint = fp
+                self._stale_reason = None
+                return "unchanged"
+            self._swap(p, got)
+            self._fingerprint = fp
+            self._stale_reason = None
+            self.stats.count("reloads")
+            self.log(f"serve: reloaded to checkpoint step {got}"
+                     + (f" (asked for {step})"
+                        if step is not None and got != step else ""))
+            return "reloaded"
+        except Exception as e:  # noqa: BLE001 — degrade, never crash
+            self.stats.count("reload_failures")
+            self._stale_reason = (
+                f"reload to step {step} failed ({type(e).__name__}); "
+                f"serving stale step {self.params_step}")
+            self.log(f"warning: explicit reload to step {step} failed "
+                     f"({type(e).__name__}: {e}); keeping params from "
+                     f"step {self.params_step}")
+            return "failed"
+
+    # -- health -------------------------------------------------------------
+    def health(self) -> Dict[str, Any]:
+        """Honest liveness verdict for /healthz and the fleet router.
+        Degrades (ok=False) when the engine is *wedged* — `spec.
+        degraded_after` consecutive failed batches — or *stale* — a
+        refused/failed reload left it serving params older than what
+        the workspace holds.  A healthy report is earned, not
+        unconditional."""
+        reasons = []
+        k = int(self.spec.degraded_after)
+        streak = self.stats.consecutive_batch_failures
+        if streak >= k:
+            reasons.append(f"{streak} consecutive failed batches "
+                           f"(threshold {k})")
+        if self._stale_reason is not None:
+            reasons.append(self._stale_reason)
+        return {"ok": not reasons,
+                "status": "ok" if not reasons else "degraded",
+                "step": self.params_step,
+                "pinned": self.pinned,
+                "reasons": reasons}
 
     # -- compiled programs --------------------------------------------------
     def _build_generate(self, batch: int, prompt_len: int):

@@ -1,0 +1,646 @@
+"""Fault-tolerant serving fleet: N engine workers behind a `Router`,
+with rolling checkpoint rollout (canary → promote / auto-rollback).
+
+`EngineFleet` spawns (in-process threads — the CPU-test and
+single-machine shape) or adopts (subprocesses over HTTP, membership
+from `parallel.bootstrap.parse_hostfile`) N engine workers, pins each
+engine's fingerprint (no self-reload), and fronts them with a
+`Router` (router.py: least-loaded healthy dispatch, quarantine/
+readmission, retry-on-other-engine, router-level shedding).
+
+The rollout state machine (`RolloutController`) closes the loop the
+single-engine tier could not: a new checkpoint fingerprint is never
+trusted fleet-wide.
+
+    OBSERVE   poll `CheckpointManager.fingerprint()` (two stats, no
+              reads).  A new latest step that is neither the pinned
+              step nor an already-rejected fingerprint starts a
+              canary.
+    CANARY    exactly ONE engine (the least-loaded healthy one)
+              reloads to the target step — deliberately WITHOUT the
+              healthy-verdict walk-back: the canary exists to absorb
+              the blast radius, so a DIVERGED or torn snapshot can
+              never touch more than 1/N of traffic.  A reload that
+              fails or lands elsewhere (torn target) is a counted
+              refusal: the fleet never serves the fingerprint at all.
+              While canarying: the canary dying / getting quarantined
+              rolls back immediately (never a deadlock), and a NEWER
+              fingerprint landing on disk aborts and restarts the
+              canary on the newest step (stale canaries are wasted
+              blast radius).
+    PROMOTE   after `window_s` of canary traffic, promote fleet-wide
+              only if the manifest health verdict is ok AND the
+              canary's own health held AND its error rate and p95
+              stayed within tolerance of the pre-canary window.
+              Remaining engines reload one at a time (rolling — the
+              fleet keeps serving throughout).
+    ROLLBACK  any failed gate reloads the canary back to the pinned
+              step and records the fingerprint as rejected (not
+              re-canaried every poll; a new save changes it again).
+
+Fault sites: `fleet.dispatch` (router attempt — behaves exactly like
+an engine failure), `fleet.rollout` (controller tick — aborts the
+rollout safely: rollback, never promote).  Events: `fleet.canary`,
+`fleet.promote`, `fleet.rollback`, `fleet.quarantine`,
+`fleet.readmit` (docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from .. import obs
+from ..utils import faults
+from ..utils.checkpoint import CheckpointManager
+from .engine import InferenceEngine, ServeSpec
+from .router import (LocalEngineHandle, Router, RouterSpec,
+                     HttpEngineHandle)
+from .server import InferenceServer
+
+
+@dataclass(frozen=True)
+class RolloutSpec:
+    """`--rollout_spec` grammar (ServeSpec mold): comma/semicolon-
+    separated `key=value`."""
+    poll_s: float = 0.25         # fingerprint poll cadence
+    window_s: float = 1.0        # canary observation window
+    min_requests: int = 0        # canary traffic wanted before verdict
+    max_extends: int = 2         # extra windows waiting for traffic
+    err_tolerance: float = 0.05  # canary err-rate − baseline bound
+    p95_ratio: float = 3.0       # canary p95 / baseline p95 bound
+    seed: int = 0
+
+    def __post_init__(self):
+        if float(self.poll_s) <= 0:
+            raise ValueError(f"poll_s must be > 0, got {self.poll_s}")
+        if float(self.window_s) <= 0:
+            raise ValueError(f"window_s must be > 0, got "
+                             f"{self.window_s}")
+        if float(self.p95_ratio) <= 0:
+            raise ValueError(f"p95_ratio must be > 0, got "
+                             f"{self.p95_ratio}")
+
+    @classmethod
+    def parse(cls, spec: Optional[str]) -> "RolloutSpec":
+        kw: Dict[str, Any] = {}
+        types = {f.name: f.type for f in dataclasses.fields(cls)}
+        for part in (spec or "").replace(";", ",").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            try:
+                key, sep, val = part.partition("=")
+                key, val = key.strip(), val.strip()
+                if not sep or key not in types:
+                    raise ValueError(f"unknown key {key!r}")
+                kw[key] = (float(val) if "float" in str(types[key])
+                           else int(val))
+            except ValueError as e:
+                raise ValueError(f"bad rollout spec entry {part!r} "
+                                 f"(want key=value): {e}") from e
+        return cls(**kw)
+
+
+class RolloutController:
+    """The OBSERVE→CANARY→PROMOTE/ROLLBACK state machine (module
+    docstring).  One daemon thread ticks every `spec.poll_s`; every
+    transition is counted, logged, and evented."""
+
+    def __init__(self, router: Router, workspace: str,
+                 spec: Optional[RolloutSpec] = None, log_fn=print):
+        self.router = router
+        self.spec = spec or RolloutSpec()
+        self.log = log_fn
+        self.mgr = CheckpointManager(workspace, log_fn=lambda s: None)
+        self.state = "OBSERVE"
+        self.pinned_step: int = -1
+        self.target_step: Optional[int] = None
+        self.canary: Optional[str] = None       # engine name
+        self._fp: Optional[tuple] = None
+        self._rejected_fp: Optional[tuple] = None
+        self._deadline: float = 0.0
+        self._extends: int = 0
+        self._pre: Dict[str, Any] = {}          # canary stats pre-reload
+        self._baseline_p95: Optional[float] = None
+        # outcome counters (fleet snapshot / BENCH_pr7.json)
+        self.canaries = 0
+        self.canary_restarts = 0
+        self.promotions = 0
+        self.rollbacks = 0
+        self.refusals = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self, pinned_step: int) -> "RolloutController":
+        self.pinned_step = int(pinned_step)
+        self._fp = self.mgr.fingerprint()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="fleet-rollout",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(10.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(float(self.spec.poll_s)):
+            self.tick()
+
+    # -- one tick -----------------------------------------------------------
+    def tick(self) -> None:
+        """One state-machine step (also callable directly: tests and
+        the bench drive rollout timing deterministically).  An
+        injected `fleet.rollout` fault — or any unexpected controller
+        error — aborts the rollout SAFELY: mid-canary it rolls back,
+        and the fleet never promotes on a faulted tick."""
+        with self._lock:
+            try:
+                faults.maybe_fault("fleet.rollout")
+                if self.state == "OBSERVE":
+                    self._tick_observe()
+                elif self.state == "CANARY":
+                    self._tick_canary()
+            except Exception as e:  # noqa: BLE001 — degrade, never die
+                self.log(f"warning: rollout tick failed "
+                         f"({type(e).__name__}: {e})"
+                         + ("; rolling canary back"
+                            if self.state == "CANARY" else ""))
+                if self.state == "CANARY":
+                    self._rollback(f"rollout fault: {e}")
+
+    def _tick_observe(self) -> None:
+        fp = self.mgr.fingerprint()
+        if fp == self._fp and self.target_step is None:
+            return
+        self._fp = fp
+        if fp == self._rejected_fp:
+            return                 # already judged and rolled back
+        target = self.mgr.latest_step()
+        if target is None or target == self.pinned_step:
+            return
+        self._begin_canary(target)
+
+    def _begin_canary(self, target: int) -> None:
+        name = self.router.pick_canary()
+        if name is None:
+            # no healthy engine to canary on — remember the target and
+            # retry next tick rather than wedging
+            self.target_step = target
+            return
+        self.target_step = target
+        handle = self.router.handle_for(name)
+        pre = self._engine_counts(handle)
+        self._baseline_p95 = self.router.stats.latency_quantile(0.95)
+        with obs.span("fleet.rollout", phase="canary", engine=name,
+                      target=target):
+            try:
+                got = handle.reload(step=target)
+            except Exception as e:  # noqa: BLE001 — engine died on us
+                got = {"outcome": "failed", "step": -1,
+                       "error": str(e)}
+        if got.get("outcome") not in ("reloaded", "unchanged") or \
+                int(got.get("step", -1)) != target:
+            # the target never made it onto ANY engine (failed/refused
+            # reload, or a torn snapshot the restore walked back past)
+            self.refusals += 1
+            self._rejected_fp = self._fp
+            self.target_step = None
+            self.log(f"fleet: rollout to step {target} refused on "
+                     f"canary {name} ({got.get('outcome')}, landed "
+                     f"step {got.get('step')}); fleet stays on "
+                     f"step {self.pinned_step}")
+            obs.emit_event("fleet.rollback", engine=name,
+                           target=target, why="canary reload refused",
+                           outcome=str(got.get("outcome")))
+            # belt and braces: make sure the canary still serves the
+            # pinned params (a failed reload never unseats them, but a
+            # walk-back may have landed elsewhere)
+            self._restore_canary(name)
+            return
+        self.canaries += 1
+        self.canary = name
+        self.state = "CANARY"
+        self._pre = pre
+        self._deadline = time.monotonic() + float(self.spec.window_s)
+        self._extends = 0
+        self.log(f"fleet: canarying checkpoint step {target} on "
+                 f"engine {name} (fleet pinned at "
+                 f"{self.pinned_step})")
+        obs.emit_event("fleet.canary", engine=name, target=target,
+                       pinned=self.pinned_step)
+
+    def _tick_canary(self) -> None:
+        # newest-wins: a fresher fingerprint mid-canary restarts the
+        # canary on the newest step (finishing a stale canary would
+        # just delay the real rollout)
+        fp = self.mgr.fingerprint()
+        if fp != self._fp:
+            self._fp = fp
+            newest = self.mgr.latest_step()
+            if newest is not None and newest != self.target_step and \
+                    fp != self._rejected_fp:
+                self.canary_restarts += 1
+                name, old = self.canary, self.target_step
+                self.log(f"fleet: newer checkpoint step {newest} "
+                         f"landed mid-canary (was canarying {old}); "
+                         f"restarting canary on the newest")
+                self._restore_canary(name)
+                self.state = "OBSERVE"
+                self.canary = None
+                self._begin_canary(newest)
+                return
+        # canary death / quarantine: roll back, never deadlock
+        mem = {m["name"]: m for m in self.router.members()}
+        m = mem.get(self.canary)
+        if m is None or m["quarantined"] or not m["healthy"]:
+            self._rollback("canary engine died or degraded "
+                           "mid-canary")
+            return
+        if time.monotonic() < self._deadline:
+            return
+        self._evaluate()
+
+    def _engine_counts(self, handle) -> Dict[str, Any]:
+        try:
+            snap = handle.stats_snapshot()
+        except Exception:  # noqa: BLE001 — dead engine: empty counts
+            snap = {}
+        return {"completed": int(snap.get("completed", 0)),
+                "failed": int(snap.get("failed", 0)),
+                "expired": int(snap.get("expired", 0))}
+
+    def _evaluate(self) -> None:
+        """The promotion gate: manifest verdict + canary health +
+        error rate + p95, all against the pre-canary window."""
+        name, target = self.canary, self.target_step
+        handle = self.router.handle_for(name)
+        post = self._engine_counts(handle)
+        served = post["completed"] - self._pre["completed"]
+        if served < int(self.spec.min_requests) and \
+                self._extends < int(self.spec.max_extends):
+            # not enough canary traffic to judge yet — extend the
+            # window a bounded number of times, then judge anyway
+            self._extends += 1
+            self._deadline = time.monotonic() + \
+                float(self.spec.window_s)
+            return
+        reasons = []
+        verdict = self.mgr.health_verdict(target)
+        if verdict is not None and verdict != "ok":
+            reasons.append(f"manifest health verdict {verdict!r}")
+        mem = {m["name"]: m for m in self.router.members()}
+        m = mem.get(name)
+        if m is None or m["quarantined"] or not m["healthy"]:
+            reasons.append("canary engine unhealthy at evaluation")
+        errs = (post["failed"] - self._pre["failed"]) + \
+            (post["expired"] - self._pre["expired"])
+        err_rate = errs / max(served + errs, 1)
+        if err_rate > float(self.spec.err_tolerance):
+            reasons.append(f"canary error rate {err_rate:.3f} > "
+                           f"{self.spec.err_tolerance}")
+        try:
+            snap = handle.stats_snapshot()
+            p95 = snap.get("p95_latency_ms")
+        except Exception:  # noqa: BLE001
+            p95 = None
+        if p95 is not None and self._baseline_p95 is not None:
+            base_ms = self._baseline_p95 * 1e3
+            if base_ms > 0 and p95 > base_ms * float(
+                    self.spec.p95_ratio):
+                reasons.append(f"canary p95 {p95:.1f}ms > "
+                               f"{self.spec.p95_ratio}x baseline "
+                               f"{base_ms:.1f}ms")
+        if reasons:
+            self._rollback("; ".join(reasons))
+        else:
+            self._promote(served)
+
+    def _promote(self, served: int) -> None:
+        name, target = self.canary, self.target_step
+        failures = []
+        with obs.span("fleet.rollout", phase="promote", target=target):
+            for other in self.router.names():
+                if other == name:
+                    continue
+                handle = self.router.handle_for(other)
+                try:
+                    got = handle.reload(step=target)
+                except Exception as e:  # noqa: BLE001 — router will
+                    got = {"outcome": "failed", "error": str(e)}
+                if got.get("outcome") not in ("reloaded", "unchanged"):
+                    # quarantine/degrade machinery picks this engine
+                    # up; the rollout itself still promotes
+                    failures.append((other, got.get("outcome")))
+        self.promotions += 1
+        self.pinned_step = target
+        self._rejected_fp = None
+        self._fp = self.mgr.fingerprint()
+        self.state = "OBSERVE"
+        self.canary = None
+        self.target_step = None
+        self.log(f"fleet: promoted checkpoint step {target} "
+                 f"fleet-wide (canary {name} served {served} "
+                 f"request(s))"
+                 + (f"; reload failed on {failures}" if failures
+                    else ""))
+        obs.emit_event("fleet.promote", target=target, canary=name,
+                       canary_served=served,
+                       failed_members=[f[0] for f in failures])
+
+    def _rollback(self, why: str) -> None:
+        name, target = self.canary, self.target_step
+        self._rejected_fp = self._fp
+        self.state = "OBSERVE"
+        self.canary = None
+        self.target_step = None
+        self.log(f"fleet: ROLLBACK of checkpoint step {target} "
+                 f"(canary {name}): {why}; fleet stays on step "
+                 f"{self.pinned_step}")
+        self._restore_canary(name)
+        # counted only once the canary is back on the pinned step (or
+        # confirmed dead): `rollbacks` means "rollback COMPLETED", so
+        # an observer never reads it while the bad step still serves
+        self.rollbacks += 1
+        obs.emit_event("fleet.rollback", engine=name, target=target,
+                       why=why, pinned=self.pinned_step)
+
+    def _restore_canary(self, name: Optional[str]) -> None:
+        """Put the (possibly dead) canary back on the pinned step —
+        best-effort: a dead engine is already quarantined and will be
+        re-pinned by readmission-time reload if needed."""
+        if name is None or self.pinned_step < 0:
+            return
+        try:
+            self.router.handle_for(name).reload(step=self.pinned_step)
+        except Exception as e:  # noqa: BLE001 — dead canary
+            self.log(f"fleet: could not restore canary {name} to "
+                     f"pinned step {self.pinned_step} ({e}); it "
+                     f"stays quarantined until it recovers")
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"state": self.state,
+                    "pinned_step": self.pinned_step,
+                    "target_step": self.target_step,
+                    "canary": self.canary,
+                    "canaries": self.canaries,
+                    "canary_restarts": self.canary_restarts,
+                    "promotions": self.promotions,
+                    "rollbacks": self.rollbacks,
+                    "refusals": self.refusals}
+
+
+class EngineFleet:
+    """N engine workers + router + rollout controller, owned together.
+    Build with `EngineFleet.local(...)` (in-process workers) or
+    `EngineFleet.adopt(...)` / `EngineFleet.from_hostfile(...)`
+    (subprocess workers over HTTP), then `start()`/`stop()` or use as
+    a context manager.  `generate`/`predict` route through the fleet
+    exactly as `FleetServer`'s HTTP frontend does."""
+
+    def __init__(self, handles: List[Any],
+                 workspace: Optional[str] = None,
+                 router_spec: Optional[RouterSpec] = None,
+                 rollout_spec: Optional[RolloutSpec] = None,
+                 log_fn=print):
+        self.log = log_fn
+        self.router = Router(handles, spec=router_spec, log_fn=log_fn)
+        self.rollout: Optional[RolloutController] = (
+            RolloutController(self.router, workspace,
+                              spec=rollout_spec, log_fn=log_fn)
+            if workspace else None)
+        self._local = [h for h in handles
+                       if isinstance(h, LocalEngineHandle)]
+
+    # -- constructors -------------------------------------------------------
+    @classmethod
+    def local(cls, net, spec: ServeSpec, size: int,
+              workspace: Optional[str] = None, params=None,
+              router_spec: Optional[RouterSpec] = None,
+              rollout_spec: Optional[RolloutSpec] = None,
+              warmup_modes=("generate",),
+              log_fn=print) -> "EngineFleet":
+        """Spawn `size` in-process engine workers (each its own
+        pinned engine, batcher, and stats) over one shared net."""
+        if size < 1:
+            raise ValueError(f"fleet size must be >= 1, got {size}")
+        handles = []
+        for i in range(size):
+            name = f"engine-{i}"
+            eng = InferenceEngine(
+                net, spec, workspace=workspace, params=params,
+                log_fn=(lambda s, n=name: log_fn(f"[{n}] {s}")),
+                pinned=True)
+            srv = InferenceServer(eng, http=False,
+                                  warmup_modes=warmup_modes,
+                                  log_fn=(lambda s, n=name:
+                                          log_fn(f"[{n}] {s}")))
+            handles.append(LocalEngineHandle(name, srv))
+        return cls(handles, workspace=workspace,
+                   router_spec=router_spec, rollout_spec=rollout_spec,
+                   log_fn=log_fn)
+
+    @classmethod
+    def adopt(cls, urls: List[str], workspace: Optional[str] = None,
+              router_spec: Optional[RouterSpec] = None,
+              rollout_spec: Optional[RolloutSpec] = None,
+              log_fn=print) -> "EngineFleet":
+        """Adopt already-running engine processes by base URL."""
+        handles = [HttpEngineHandle(f"engine-{i}", u)
+                   for i, u in enumerate(urls)]
+        return cls(handles, workspace=workspace,
+                   router_spec=router_spec, rollout_spec=rollout_spec,
+                   log_fn=log_fn)
+
+    @classmethod
+    def from_hostfile(cls, path: str, default_port: int = 8000,
+                      **kw) -> "EngineFleet":
+        """Adopt membership from a hostfile (one engine `host[:port]`
+        per line — `parallel.bootstrap.parse_hostfile`, which rejects
+        duplicates and empty membership)."""
+        from ..parallel.bootstrap import parse_hostfile
+        hosts = parse_hostfile(path)
+        urls = [f"http://{h}" if ":" in h
+                else f"http://{h}:{default_port}" for h in hosts]
+        return cls.adopt(urls, **kw)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "EngineFleet":
+        for h in self._local:
+            h.start()
+        self.router.start()
+        if self.rollout is not None:
+            # pin the fleet at the step the members actually serve
+            steps = [self.router.engine_step(n)
+                     for n in self.router.names()]
+            self.rollout.start(max(steps) if steps else -1)
+        n_ok = len(self.router.healthy_names())
+        self.log(f"fleet: {n_ok}/{len(self.router.names())} engine(s) "
+                 f"healthy"
+                 + (f", rollout pinned at step "
+                    f"{self.rollout.pinned_step}"
+                    if self.rollout is not None else ""))
+        return self
+
+    def stop(self) -> None:
+        if self.rollout is not None:
+            self.rollout.stop()
+        self.router.stop()
+        for h in self._local:
+            if h._alive:
+                h.stop()
+
+    def __enter__(self) -> "EngineFleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- client API ---------------------------------------------------------
+    def generate(self, tokens, timeout=None) -> Dict[str, Any]:
+        return self.router.route("generate", tokens, timeout=timeout)
+
+    def predict(self, tokens, timeout=None) -> Dict[str, Any]:
+        return self.router.route("predict", tokens, timeout=timeout)
+
+    def snapshot(self) -> Dict[str, Any]:
+        out = self.router.snapshot()
+        if self.rollout is not None:
+            out["rollout"] = self.rollout.snapshot()
+        return out
+
+
+# -- HTTP frontend ----------------------------------------------------------
+
+class FleetServer:
+    """The fleet's own stdlib-HTTP frontend (the single-engine
+    `InferenceServer`'s shape, one level up): POST /generate and
+    /predict route through the fleet; GET /stats, /metrics, /healthz
+    read the router.  /healthz is honest at fleet level too: 200 while
+    at least one engine is healthy, 503 when the whole fleet is."""
+
+    def __init__(self, fleet: EngineFleet, host: str = "127.0.0.1",
+                 port: int = 0, log_fn=print):
+        from ..obs.metrics import MetricsRegistry
+        self.fleet = fleet
+        self.log = log_fn
+        self.metrics = MetricsRegistry()
+        self.fleet.router.stats.register_into(self.metrics)
+        self._host, self._port = host, port
+        self._httpd = None
+        self._http_thread: Optional[threading.Thread] = None
+
+    def start(self) -> "FleetServer":
+        import json
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+
+        import numpy as np
+
+        from .batcher import DeadlineExpired as _DE
+        from .batcher import Overloaded as _OL
+
+        fleet, metrics = self.fleet, self.metrics
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _reply(self, code, payload, headers=None):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/stats":
+                    self._reply(200, fleet.snapshot())
+                elif self.path == "/metrics":
+                    body = metrics.render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length",
+                                     str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/healthz":
+                    healthy = len(fleet.router.healthy_names())
+                    total = len(fleet.router.names())
+                    ok = healthy > 0
+                    self._reply(200 if ok else 503, {
+                        "ok": ok,
+                        "status": "ok" if ok else "degraded",
+                        "healthy_engines": healthy,
+                        "engines": total})
+                else:
+                    self._reply(404,
+                                {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                mode = self.path.lstrip("/")
+                if mode not in ("generate", "predict"):
+                    self._reply(404,
+                                {"error": f"no route {self.path}"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    tokens = np.asarray(req["tokens"], np.int32)
+                    out = fleet.router.route(mode, tokens,
+                                             timeout=req.get(
+                                                 "timeout"))
+                    self._reply(200, out)
+                except _OL as e:
+                    self._reply(503, {"error": str(e),
+                                      "retry_after": e.retry_after},
+                                {"Retry-After":
+                                 f"{e.retry_after:.3f}"})
+                except (_DE, TimeoutError) as e:
+                    self._reply(504, {"error": str(e)})
+                except (KeyError, ValueError,
+                        json.JSONDecodeError) as e:
+                    self._reply(400, {"error": f"bad request: {e}"})
+                except Exception as e:  # noqa: BLE001
+                    self._reply(500, {"error":
+                                      f"{type(e).__name__}: {e}"})
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port),
+                                          Handler)
+        self._httpd.daemon_threads = True
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="fleet-http",
+            daemon=True)
+        self._http_thread.start()
+        self.log(f"fleet: http on {self.address[0]}:"
+                 f"{self.address[1]}")
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._http_thread = None
+
+    @property
+    def address(self):
+        return self._httpd.server_address if self._httpd else None

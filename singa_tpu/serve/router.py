@@ -1,0 +1,567 @@
+"""Fleet router: health-driven dispatch over N engine workers.
+
+One engine per process caps serving throughput at one chip's tok/s and
+makes every crash a 100% outage; the router is the horizontal half of
+the north star ("millions of users") and the modern answer to the
+reference's ZeroMQ server pool (PAPER.md L4) — survive partial failure
+by construction, the TensorFlow-paper argument (arxiv 1605.08695).
+
+Three moving parts:
+
+  * `EngineHandle` — the uniform worker surface.  `LocalEngineHandle`
+    wraps an in-process `InferenceServer` (threads: the CPU-test and
+    single-machine shape); `HttpEngineHandle` speaks to a separate
+    `singa_tpu.main serve --pinned` process over its HTTP surface
+    (/healthz, /stats, /generate, /predict, /admin/reload) — the
+    subprocess deployment whose membership comes from
+    `parallel.bootstrap.parse_hostfile`.
+  * `Router` — per-request dispatch to the least-loaded healthy
+    engine (in-flight + last-probed queue depth), with
+    retry-on-other-engine: an engine failure (connection refused, a
+    500, an injected `fleet.dispatch` fault) charges the engine a
+    strike and the request moves on; the client sees a failure only
+    when every admissible engine has been tried.  `Overloaded` from
+    one engine is load, not failure — the request retries elsewhere
+    without a strike.  When NO engine can take the request the router
+    itself sheds with `Overloaded` + an escalating Backoff
+    `Retry-After`, mirroring the MicroBatcher's admission story one
+    level up.
+  * the probe loop — every `probe_period_s` each member's
+    /healthz + ServeStats are read; a degraded verdict pulls the
+    engine out of dispatch (it re-enters the moment it reports ok),
+    while hard probe failures accumulate strikes toward quarantine.
+    Quarantine/readmission mirrors `ReplicaSet`'s poisoned-round
+    policy: `quarantine_after` consecutive strikes bench the engine
+    for a `utils.faults.Backoff` delay that doubles on each
+    consecutive re-quarantine, and a clean probe after the bench
+    readmits it (counted, evented — `fleet.quarantine` /
+    `fleet.readmit`).
+
+Rollout (canary / promote / rollback) rides on top of this in
+`fleet.py`; the router only answers "who is healthy and least loaded
+right now" and "move this request somewhere else".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .. import obs
+from ..utils import faults
+from .batcher import DeadlineExpired, Overloaded
+
+
+class EngineUnavailable(RuntimeError):
+    """The chosen engine could not take the request at all (process
+    dead, connection refused, handler crashed) — retried on another
+    engine and charged to this one as a strike."""
+
+
+@dataclass(frozen=True)
+class RouterSpec:
+    """Router config grammar (`--fleet_spec`, the ServeSpec mold):
+    comma/semicolon-separated `key=value`."""
+    probe_period_s: float = 0.25   # health-probe cadence per engine
+    quarantine_after: int = 2      # consecutive strikes -> quarantine
+    readmit_base_s: float = 0.25   # Backoff base for the bench time
+    readmit_cap_s: float = 10.0    # Backoff cap
+    max_attempts: int = 0          # engines tried per request (0 = all)
+    request_timeout_s: float = 5.0
+    seed: int = 0
+
+    def __post_init__(self):
+        if int(self.quarantine_after) < 1:
+            raise ValueError(f"quarantine_after must be >= 1, got "
+                             f"{self.quarantine_after}")
+        if float(self.probe_period_s) <= 0:
+            raise ValueError(f"probe_period_s must be > 0, got "
+                             f"{self.probe_period_s}")
+
+    @classmethod
+    def parse(cls, spec: Optional[str]) -> "RouterSpec":
+        kw: Dict[str, Any] = {}
+        types = {f.name: f.type for f in dataclasses.fields(cls)}
+        for part in (spec or "").replace(";", ",").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            try:
+                key, sep, val = part.partition("=")
+                key, val = key.strip(), val.strip()
+                if not sep or key not in types:
+                    raise ValueError(f"unknown key {key!r}")
+                kw[key] = (float(val) if "float" in str(types[key])
+                           else int(val))
+            except ValueError as e:
+                raise ValueError(f"bad fleet spec entry {part!r} "
+                                 f"(want key=value): {e}") from e
+        return cls(**kw)
+
+
+# -- engine handles ---------------------------------------------------------
+
+class LocalEngineHandle:
+    """In-process worker: a pinned `InferenceEngine` + `MicroBatcher`
+    wrapped in an `InferenceServer` (no HTTP — the router IS the
+    frontend).  `kill()`/`revive()` give tests and the bench a
+    deterministic crash/recovery lever."""
+
+    def __init__(self, name: str, server):
+        self.name = name
+        self.server = server          # serve.InferenceServer
+        self.engine = server.engine
+        self._alive = True
+
+    def start(self) -> None:
+        self.server.start()
+        self._alive = True
+
+    def stop(self) -> None:
+        self._alive = False
+        self.server.stop()
+
+    def kill(self) -> None:
+        """Simulate a worker crash: requests and probes fail until
+        revive()."""
+        self._alive = False
+        self.server.stop()
+
+    def revive(self) -> None:
+        self.server.start()
+        self._alive = True
+
+    def probe(self) -> Dict[str, Any]:
+        if not self._alive:
+            raise EngineUnavailable(f"engine {self.name} is down")
+        h = dict(self.engine.health())
+        h["queue_depth"] = self.engine.stats.queue_depth
+        return h
+
+    def stats_snapshot(self) -> Dict[str, Any]:
+        return self.server.snapshot()
+
+    def request(self, mode: str, tokens,
+                timeout: Optional[float] = None) -> Dict[str, Any]:
+        if not self._alive:
+            raise EngineUnavailable(f"engine {self.name} is down")
+        call = (self.server.generate if mode == "generate"
+                else self.server.predict)
+        try:
+            return call(tokens, timeout=timeout)
+        except (Overloaded, DeadlineExpired, TimeoutError, ValueError):
+            raise
+        except Exception as e:  # noqa: BLE001 — batch failed / stopped
+            raise EngineUnavailable(
+                f"engine {self.name} failed: {e}") from e
+
+    def reload(self, step: Optional[int] = None) -> Dict[str, Any]:
+        if not self._alive:
+            raise EngineUnavailable(f"engine {self.name} is down")
+        outcome = self.engine.reload_to(step)
+        return {"outcome": outcome, "step": self.engine.params_step}
+
+
+class HttpEngineHandle:
+    """Worker behind a URL: a `singa_tpu.main serve --pinned` process
+    (membership from a hostfile).  Maps the server's status codes back
+    to the router's exception vocabulary."""
+
+    def __init__(self, name: str, base_url: str,
+                 connect_timeout_s: float = 5.0):
+        self.name = name
+        self.base_url = base_url.rstrip("/")
+        self.connect_timeout_s = connect_timeout_s
+
+    def _call(self, method: str, path: str,
+              payload: Optional[dict] = None,
+              timeout: Optional[float] = None) -> Dict[str, Any]:
+        data = (json.dumps(payload).encode()
+                if payload is not None else None)
+        req = urllib.request.Request(
+            f"{self.base_url}{path}", data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=timeout or self.connect_timeout_s) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            body = {}
+            try:
+                body = json.loads(e.read())
+            except Exception:  # noqa: BLE001 — non-JSON error body
+                pass
+            if e.code == 503 and path == "/healthz":
+                return body or {"ok": False, "status": "degraded"}
+            if e.code == 503:
+                raise Overloaded(
+                    body.get("error", "overloaded"),
+                    retry_after=float(body.get("retry_after", 0.0)))
+            if e.code == 504:
+                raise DeadlineExpired(body.get("error", "deadline"))
+            if e.code == 400:
+                raise ValueError(body.get("error", "bad request"))
+            raise EngineUnavailable(
+                f"engine {self.name}: HTTP {e.code} "
+                f"{body.get('error', '')}")
+        except (urllib.error.URLError, ConnectionError, OSError) as e:
+            raise EngineUnavailable(
+                f"engine {self.name} unreachable: {e}") from e
+
+    def probe(self) -> Dict[str, Any]:
+        h = self._call("GET", "/healthz")
+        try:
+            snap = self._call("GET", "/stats")
+            h["queue_depth"] = snap.get("queue_depth", 0)
+        except EngineUnavailable:
+            h["queue_depth"] = 0
+        return h
+
+    def stats_snapshot(self) -> Dict[str, Any]:
+        return self._call("GET", "/stats")
+
+    def request(self, mode: str, tokens,
+                timeout: Optional[float] = None) -> Dict[str, Any]:
+        toks = (tokens.tolist() if isinstance(tokens, np.ndarray)
+                else list(tokens))
+        payload = {"tokens": [int(t) for t in toks]}
+        if timeout is not None:
+            payload["timeout"] = timeout
+        budget = (timeout or self.connect_timeout_s) + 30.0
+        return self._call("POST", f"/{mode}", payload, timeout=budget)
+
+    def reload(self, step: Optional[int] = None) -> Dict[str, Any]:
+        return self._call("POST", "/admin/reload", {"step": step},
+                          timeout=60.0)
+
+
+# -- router -----------------------------------------------------------------
+
+@dataclass
+class _Member:
+    handle: Any
+    healthy: bool = True          # last probe verdict (soft: re-enters
+    step: int = -1                # on the next ok probe)
+    queue_depth: int = 0
+    in_flight: int = 0
+    strikes: int = 0              # consecutive probe/dispatch failures
+    quarantined: bool = False
+    quarantines: int = 0          # lifetime count (drives the Backoff)
+    bench_until: float = 0.0      # monotonic readmission-probe time
+    dispatched: int = 0
+    failed: int = 0
+    last_health: Dict[str, Any] = field(default_factory=dict)
+
+
+class RouterStats:
+    """Aggregate router counters (RouterStats ≈ the fleet-level
+    ServeStats; per-engine detail lives in Router.members())."""
+
+    FIELDS = ("routed", "completed", "retried", "failed", "shed",
+              "quarantines", "readmissions")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        for f in self.FIELDS:
+            setattr(self, f, 0)
+        self._latencies: List[float] = []
+
+    def count(self, fieldname: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, fieldname, getattr(self, fieldname) + n)
+
+    def observe_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._latencies.append(seconds)
+            if len(self._latencies) > 4096:
+                del self._latencies[:2048]
+
+    def latency_quantile(self, q: float) -> Optional[float]:
+        with self._lock:
+            lats = sorted(self._latencies)
+        if not lats:
+            return None
+        return lats[min(int(q * len(lats)), len(lats) - 1)]
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            out = {f: getattr(self, f) for f in self.FIELDS}
+        p50, p95 = (self.latency_quantile(0.5),
+                    self.latency_quantile(0.95))
+        out["p50_latency_ms"] = (round(p50 * 1e3, 3)
+                                 if p50 is not None else None)
+        out["p95_latency_ms"] = (round(p95 * 1e3, 3)
+                                 if p95 is not None else None)
+        return out
+
+    def register_into(self, registry,
+                      prefix: str = "singa_fleet") -> None:
+        from ..obs.metrics import Sample
+
+        def collect():
+            snap = self.snapshot()
+            out = [Sample(f"{prefix}_{k}_total", "counter",
+                          f"fleet router counter {k!r}",
+                          float(snap[k])) for k in self.FIELDS]
+            out += [Sample(f"{prefix}_{k}", "gauge",
+                           f"fleet router gauge {k!r}", float(snap[k]))
+                    for k in ("p50_latency_ms", "p95_latency_ms")
+                    if snap.get(k) is not None]
+            return out
+
+        registry.register_collector(collect)
+
+
+class Router:
+    """See module docstring.  Thread-safe: frontend threads call
+    `route`, one daemon thread runs `_probe_loop`, and the rollout
+    controller reads `members()` / calls `handle_for`."""
+
+    def __init__(self, handles: List[Any],
+                 spec: Optional[RouterSpec] = None, log_fn=print):
+        if not handles:
+            raise ValueError("Router needs at least one engine handle")
+        names = [h.name for h in handles]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate engine names: {names}")
+        self.spec = spec or RouterSpec()
+        self.log = log_fn
+        self.stats = RouterStats()
+        self._lock = threading.Lock()
+        self._members: Dict[str, _Member] = {
+            h.name: _Member(handle=h) for h in handles}
+        self._backoff = faults.Backoff(base=self.spec.readmit_base_s,
+                                       cap=self.spec.readmit_cap_s,
+                                       seed=self.spec.seed)
+        self._shed_backoff = faults.Backoff(base=0.05, cap=2.0,
+                                            seed=self.spec.seed + 1)
+        self._sheds_in_a_row = 0
+        self._probe_stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "Router":
+        self.probe_all()              # first verdicts before traffic
+        self._probe_stop.clear()
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name="fleet-probe", daemon=True)
+        self._probe_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._probe_stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(5.0)
+            self._probe_thread = None
+
+    # -- membership reads ---------------------------------------------------
+    def names(self) -> List[str]:
+        with self._lock:
+            return list(self._members)
+
+    def handle_for(self, name: str):
+        return self._members[name].handle
+
+    def members(self) -> List[Dict[str, Any]]:
+        """Point-in-time per-engine view (stats/rollout surface)."""
+        with self._lock:
+            return [{
+                "name": n, "healthy": m.healthy,
+                "quarantined": m.quarantined, "strikes": m.strikes,
+                "step": m.step, "in_flight": m.in_flight,
+                "queue_depth": m.queue_depth,
+                "dispatched": m.dispatched, "failed": m.failed,
+                "quarantines": m.quarantines,
+            } for n, m in self._members.items()]
+
+    def healthy_names(self) -> List[str]:
+        with self._lock:
+            return [n for n, m in self._members.items()
+                    if m.healthy and not m.quarantined]
+
+    def engine_step(self, name: str) -> int:
+        with self._lock:
+            return self._members[name].step
+
+    # -- probing ------------------------------------------------------------
+    def _probe_loop(self) -> None:
+        period = float(self.spec.probe_period_s)
+        while not self._probe_stop.wait(period):
+            self.probe_all()
+
+    def probe_all(self) -> None:
+        """One probe round over every member (also callable directly —
+        tests and the rollout controller tighten timing with it)."""
+        for name in self.names():
+            self._probe_one(name)
+
+    def _probe_one(self, name: str) -> None:
+        m = self._members[name]
+        now = time.monotonic()
+        if m.quarantined and now < m.bench_until:
+            return                    # still benched; don't even probe
+        try:
+            with obs.span("router.probe", engine=name):
+                h = m.handle.probe()
+        except Exception as e:  # noqa: BLE001 — probe failure = strike
+            self._strike(name, f"probe failed: {e}")
+            return
+        with self._lock:
+            was_quarantined = m.quarantined
+            m.last_health = h
+            m.healthy = bool(h.get("ok"))
+            m.step = int(h.get("step", -1))
+            m.queue_depth = int(h.get("queue_depth", 0))
+            if m.healthy:
+                m.strikes = 0
+                if was_quarantined:
+                    m.quarantined = False
+                    self.stats.count("readmissions")
+        if m.healthy and was_quarantined:
+            self.log(f"fleet: engine {name} readmitted after "
+                     f"quarantine (probe ok, step {m.step})")
+            obs.emit_event("fleet.readmit", engine=name, step=m.step)
+
+    def _strike(self, name: str, why: str) -> None:
+        """One probe/dispatch failure; `quarantine_after` consecutive
+        strikes bench the engine for a Backoff delay that escalates
+        with each consecutive quarantine (the ReplicaSet
+        poisoned-round policy, serving-side)."""
+        m = self._members[name]
+        with self._lock:
+            m.strikes += 1
+            m.healthy = False
+            if m.strikes < self.spec.quarantine_after or m.quarantined:
+                if m.quarantined:
+                    # failed its readmission probe: bench it again,
+                    # longer (the strike streak keeps growing)
+                    m.quarantines += 1
+                    m.bench_until = time.monotonic() + \
+                        self._backoff.delay(m.quarantines - 1)
+                return
+            m.quarantined = True
+            m.quarantines += 1
+            delay = self._backoff.delay(m.quarantines - 1)
+            m.bench_until = time.monotonic() + delay
+            self.stats.count("quarantines")
+        self.log(f"fleet: engine {name} quarantined for "
+                 f"{delay:.2f}s ({why})")
+        obs.emit_event("fleet.quarantine", engine=name, why=why,
+                       bench_s=round(delay, 4))
+
+    # -- dispatch -----------------------------------------------------------
+    def _pick(self, exclude: set) -> Optional[str]:
+        """Least-loaded healthy engine (in-flight + probed queue
+        depth), excluding already-tried ones."""
+        with self._lock:
+            cands = [(m.in_flight + m.queue_depth, n)
+                     for n, m in self._members.items()
+                     if n not in exclude and m.healthy
+                     and not m.quarantined]
+            if not cands:
+                return None
+            _, name = min(cands)
+            self._members[name].in_flight += 1
+            return name
+
+    def _release(self, name: str) -> None:
+        with self._lock:
+            self._members[name].in_flight -= 1
+
+    def route(self, mode: str, tokens,
+              timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Dispatch one request; retries engine failures on other
+        engines and sheds (`Overloaded` + Retry-After) only when no
+        engine can take it.  The result carries `engine`, the member
+        that served it."""
+        if timeout is None:
+            timeout = self.spec.request_timeout_s
+        t0 = time.monotonic()
+        self.stats.count("routed")
+        budget = (self.spec.max_attempts
+                  if self.spec.max_attempts > 0 else len(self._members))
+        tried: set = set()
+        saturated = 0
+        with obs.span("router.dispatch", mode=mode) as sp:
+            for attempt in range(budget):
+                name = self._pick(tried)
+                if name is None:
+                    break
+                tried.add(name)
+                m = self._members[name]
+                try:
+                    faults.maybe_fault("fleet.dispatch")
+                    out = m.handle.request(mode, tokens,
+                                           timeout=timeout)
+                except Overloaded:
+                    # load, not failure: no strike, try a sibling
+                    saturated += 1
+                    self.stats.count("retried")
+                    continue
+                except (DeadlineExpired, TimeoutError):
+                    # the request's own deadline died inside the
+                    # engine; retrying elsewhere would only blow it
+                    # further — surface it
+                    self.stats.count("failed")
+                    raise
+                except ValueError:
+                    self.stats.count("failed")
+                    raise          # unservable request, not a failure
+                except Exception as e:  # noqa: BLE001 — engine failure
+                    with self._lock:
+                        m.failed += 1
+                    self._strike(name, f"dispatch failed: {e}")
+                    self.stats.count("retried")
+                    continue
+                finally:
+                    self._release(name)
+                with self._lock:
+                    m.dispatched += 1
+                    self._sheds_in_a_row = 0
+                self.stats.count("completed")
+                self.stats.observe_latency(time.monotonic() - t0)
+                out["engine"] = name
+                sp.set(engine=name, attempts=attempt + 1)
+                return out
+            # nothing left to try: the fleet is saturated or down
+            why = ("fleet saturated" if saturated
+                   else "no healthy engine available"
+                   if not tried else
+                   f"all {len(tried)} reachable engine(s) failed")
+            self._shed(why)
+
+    def _shed(self, why: str) -> None:
+        with self._lock:
+            self._sheds_in_a_row += 1
+            attempt = self._sheds_in_a_row
+        self.stats.count("shed")
+        retry = self._shed_backoff.delay(attempt - 1)
+        obs.emit_event("serve.shed", why=f"router: {why}",
+                       retry_after=round(retry, 4))
+        raise Overloaded(f"request shed ({why}); retry after "
+                         f"{retry:.3f}s", retry_after=retry)
+
+    # -- rollout support ----------------------------------------------------
+    def pick_canary(self) -> Optional[str]:
+        """The engine to canary a new checkpoint on: healthy and
+        carrying the LEAST traffic — a bad fingerprint should touch as
+        little of the fleet's load as possible."""
+        with self._lock:
+            cands = [(m.in_flight + m.queue_depth, n)
+                     for n, m in self._members.items()
+                     if m.healthy and not m.quarantined]
+        return min(cands)[1] if cands else None
+
+    def snapshot(self) -> Dict[str, Any]:
+        out = self.stats.snapshot()
+        out["engines"] = self.members()
+        out["healthy_engines"] = len(self.healthy_names())
+        return out

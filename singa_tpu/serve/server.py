@@ -20,7 +20,16 @@ Endpoints:
                     (each server owns a MetricsRegistry; the collector
                     reads ServeStats.snapshot(), so /metrics and /stats
                     agree by construction)
-    GET  /healthz   {"ok": true, "step": n}
+    GET  /healthz   engine.health(): 200 {"ok": true, ...} only while
+                    the engine is actually healthy; 503 with
+                    {"ok": false, "status": "degraded", "reasons"}
+                    after `degraded_after` consecutive failed batches
+                    or a refused/failed reload leaving stale params —
+                    the signal the fleet router dispatches on
+    POST /admin/reload  {"step": n?} -> engine.reload_to(step): the
+                    fleet rollout controller's command channel for
+                    remote (subprocess) engine members; returns
+                    {"outcome", "step"}
 Status mapping: 503 + Retry-After on `Overloaded` (shed), 504 on
 deadline/timeout, 400 on a malformed request, 500 on a failed batch.
 
@@ -73,7 +82,13 @@ class InferenceServer:
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "InferenceServer":
-        if self.engine.params is None:
+        if self.engine.params is None or (
+                self.engine.ckpt is not None
+                and self.engine.params_step < 0):
+            # no params yet, or constructor-fallback params with a
+            # workspace that may hold something better: load() prefers
+            # the latest healthy snapshot and keeps the fallback only
+            # when nothing is restorable
             self.engine.load()
         n = self.engine.warmup(self._warmup_modes)
         self.log(f"serve: warmed {n} program(s) for buckets "
@@ -81,9 +96,13 @@ class InferenceServer:
                  f"step {self.engine.params_step}")
         self.batcher.start()
         self._poll_stop.clear()
-        self._poll_thread = threading.Thread(
-            target=self._poll_loop, name="serve-reload", daemon=True)
-        self._poll_thread.start()
+        if not self.engine.pinned:
+            # pinned (fleet-member) engines never self-reload — the
+            # rollout controller drives reload_to explicitly
+            self._poll_thread = threading.Thread(
+                target=self._poll_loop, name="serve-reload",
+                daemon=True)
+            self._poll_thread.start()
         if self._http_wanted:
             self._httpd = ThreadingHTTPServer(
                 (self._host, self._port), _make_handler(self))
@@ -196,13 +215,26 @@ def _make_handler(server: InferenceServer):
             elif self.path == "/metrics":
                 self._reply_text(200, server.metrics.render_prometheus())
             elif self.path == "/healthz":
-                self._reply(200, {"ok": True,
-                                  "step": server.engine.params_step})
+                h = server.engine.health()
+                self._reply(200 if h["ok"] else 503, h)
             else:
                 self._reply(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):
             mode = self.path.lstrip("/")
+            if self.path == "/admin/reload":
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    step = req.get("step")
+                    outcome = server.engine.reload_to(
+                        None if step is None else int(step))
+                    self._reply(200, {
+                        "outcome": outcome,
+                        "step": server.engine.params_step})
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._reply(400, {"error": f"bad request: {e}"})
+                return
             if mode not in ("generate", "predict"):
                 self._reply(404, {"error": f"no route {self.path}"})
                 return

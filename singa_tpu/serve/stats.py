@@ -57,6 +57,10 @@ class ServeStats:
         self.batches = 0
         self.batched_requests = 0
         self.batch_slots = 0     # sum of bucket batch sizes dispatched
+        # gauge: dispatched batches failed in a row (reset by any
+        # successful batch) — the wedged-engine signal /healthz
+        # degrades on once it crosses ServeSpec.degraded_after
+        self.consecutive_batch_failures = 0
         # engine
         self.compiles = 0
         self.reloads = 0
@@ -81,6 +85,11 @@ class ServeStats:
             self.batches += 1
             self.batched_requests += requests
             self.batch_slots += slots
+            self.consecutive_batch_failures = 0
+
+    def observe_batch_failure(self) -> None:
+        with self._lock:
+            self.consecutive_batch_failures += 1
 
     def observe_latency(self, seconds: float) -> None:
         with self._lock:
@@ -138,9 +147,9 @@ class ServeStats:
                     "shed", "batches", "batched_requests",
                     "batch_slots", "compiles", "reloads",
                     "reload_failures", "reloads_refused")
-        gauges = ("queue_depth", "qps", "qps_recent", "uptime_s",
-                  "p50_latency_ms", "p95_latency_ms",
-                  "batch_occupancy")
+        gauges = ("queue_depth", "consecutive_batch_failures", "qps",
+                  "qps_recent", "uptime_s", "p50_latency_ms",
+                  "p95_latency_ms", "batch_occupancy")
 
         def collect():
             snap = self.snapshot()
@@ -170,6 +179,8 @@ class ServeStats:
                 "batches": self.batches,
                 "batched_requests": self.batched_requests,
                 "batch_slots": self.batch_slots,
+                "consecutive_batch_failures":
+                    self.consecutive_batch_failures,
                 "compiles": self.compiles,
                 "reloads": self.reloads,
                 "reload_failures": self.reload_failures,

@@ -39,8 +39,18 @@ restart — so a one-shot fault never re-fires during recovery):
                    (MicroBatcher dispatch loop — an error fails that
                    batch's requests; the server stays up)
     serve.reload   one checkpoint hot-reload attempt
-                   (InferenceEngine.poll_reload — an error degrades to
-                   keep-serving-old-params, counted in ServeStats)
+                   (InferenceEngine.poll_reload / reload_to — an error
+                   degrades to keep-serving-old-params, counted in
+                   ServeStats; on a fleet canary it turns the rollout
+                   into a counted refusal)
+    fleet.dispatch one routed request attempt (Router.route — an error
+                   is charged to the chosen engine exactly like a real
+                   engine failure: the request retries on another
+                   engine and the engine earns a strike)
+    fleet.rollout  one rollout-controller tick (RolloutController —
+                   an error mid-canary aborts the rollout safely:
+                   the canary is rolled back to the pinned step and
+                   the fleet never promotes)
     obs.emit       one telemetry record written (a span recorded, an
                    event-log line appended, a trace exported — every
                    obs write path swallows the fault into a drop
@@ -81,7 +91,7 @@ from typing import Dict, List, Optional
 SITES = ("data.decode", "data.prefetch", "feed.stage", "ckpt.save",
          "ckpt.restore", "sync.elastic", "sync.delta", "step.train",
          "step.grad", "serve.admit", "serve.batch", "serve.reload",
-         "obs.emit")
+         "fleet.dispatch", "fleet.rollout", "obs.emit")
 
 KINDS = ("error", "preempt", "corrupt", "torn", "nan", "spike")
 

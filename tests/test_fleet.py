@@ -1,0 +1,504 @@
+"""Serving fleet (singa_tpu/serve/router.py + fleet.py): health-driven
+dispatch, quarantine/readmission, router shedding, and the canary
+rollout state machine (OBSERVE -> CANARY -> PROMOTE/ROLLBACK).
+
+Correctness anchors:
+  * killing an engine never surfaces as a client failure while a
+    healthy sibling exists — requests retry elsewhere, the dead engine
+    is quarantined and readmitted on recovery;
+  * a bad checkpoint fingerprint can touch at most ONE engine: a
+    DIVERGED manifest verdict, a dead canary, or an injected
+    `serve.reload` fault all end in rollback with the fleet pinned.
+
+Cost control: router and rollout logic is exercised through stub
+handles (no compiled programs, no threads — probe rounds and rollout
+ticks are driven explicitly); exactly one test builds a real 2-engine
+fleet over the tiny test LM with a single (2, 6) bucket."""
+
+import tempfile
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from singa_tpu.core.net import build_net
+from singa_tpu.models.transformer import transformer_lm
+from singa_tpu.parallel.bootstrap import parse_hostfile
+from singa_tpu.serve import (EngineFleet, EngineUnavailable,
+                             InferenceEngine, InferenceServer,
+                             Overloaded, RolloutController, RolloutSpec,
+                             Router, RouterSpec, ServeSpec)
+from singa_tpu.utils.checkpoint import CheckpointManager
+from singa_tpu.utils.faults import FaultSchedule, inject
+
+pytestmark = pytest.mark.fleet
+
+VOCAB, SEQ = 64, 16
+SHAPES = {"data": {"input": (SEQ,), "target": (SEQ,)}}
+
+
+def _net_and_params(seed=0):
+    cfg = transformer_lm(vocab_size=VOCAB, num_layers=2, embed_dim=32,
+                         num_heads=4, head_dim=8, seq_len=SEQ,
+                         batchsize=2)
+    net = build_net(cfg, "kTest", SHAPES)
+    return net, net.init_params(jax.random.PRNGKey(seed))
+
+
+def _save(mgr, step, params, verdict="ok"):
+    mgr.save(step, params, {"t": np.zeros(())},
+             health={"verdict": verdict})
+
+
+# -- spec grammars -----------------------------------------------------------
+
+def test_router_spec_parse_grammar():
+    s = RouterSpec.parse("probe_period_s=0.1,quarantine_after=3;"
+                         "readmit_base_s=0.5,max_attempts=2")
+    assert s.probe_period_s == 0.1 and s.quarantine_after == 3
+    assert s.readmit_base_s == 0.5 and s.max_attempts == 2
+    assert RouterSpec.parse(None) == RouterSpec()
+    with pytest.raises(ValueError, match="unknown key"):
+        RouterSpec.parse("bogus=1")
+    with pytest.raises(ValueError):
+        RouterSpec.parse("quarantine_after=0")
+
+
+def test_rollout_spec_parse_grammar():
+    s = RolloutSpec.parse("window_s=2.5,min_requests=10;p95_ratio=4")
+    assert s.window_s == 2.5 and s.min_requests == 10
+    assert s.p95_ratio == 4.0
+    assert RolloutSpec.parse("") == RolloutSpec()
+    with pytest.raises(ValueError, match="unknown key"):
+        RolloutSpec.parse("nope=2")
+    with pytest.raises(ValueError):
+        RolloutSpec.parse("window_s=0")
+
+
+# -- hostfile membership hardening -------------------------------------------
+
+def test_parse_hostfile_rejects_duplicates(tmp_path):
+    p = tmp_path / "hosts"
+    p.write_text("10.0.0.1:8000\n10.0.0.2:8000\n10.0.0.1:8000\n")
+    with pytest.raises(ValueError, match="duplicate host"):
+        parse_hostfile(str(p))
+
+
+def test_parse_hostfile_rejects_empty_membership(tmp_path):
+    p = tmp_path / "hosts"
+    p.write_text("# fleet members\n\n   \n# none yet\n")
+    with pytest.raises(ValueError, match="no hosts"):
+        parse_hostfile(str(p))
+    p2 = tmp_path / "hosts2"
+    p2.write_text("")
+    with pytest.raises(ValueError, match="no hosts"):
+        parse_hostfile(str(p2))
+
+
+# -- stub engine handle ------------------------------------------------------
+
+class StubHandle:
+    """Engine handle test double: scriptable health, load, failure,
+    and reload behavior; no threads, no compiled programs."""
+
+    def __init__(self, name, step=1, queue_depth=0):
+        self.name = name
+        self.step = step
+        self.queue_depth = queue_depth
+        self.fail_probe = False
+        self.fail_request = False
+        self.overloaded = False
+        self.reload_error = False
+        self.reload_refuse = False
+        self.served = 0
+        self.reloads = []
+        self.failed = 0
+
+    def probe(self):
+        if self.fail_probe:
+            raise EngineUnavailable(f"{self.name} is down")
+        return {"ok": True, "status": "ok", "step": self.step,
+                "queue_depth": self.queue_depth}
+
+    def stats_snapshot(self):
+        return {"completed": self.served, "failed": self.failed,
+                "expired": 0, "p95_latency_ms": None}
+
+    def request(self, mode, tokens, timeout=None):
+        if self.fail_request:
+            self.failed += 1
+            raise EngineUnavailable(f"{self.name} crashed")
+        if self.overloaded:
+            raise Overloaded(f"{self.name} full", retry_after=0.01)
+        self.served += 1
+        return {"tokens": [1, 2], "step": self.step}
+
+    def reload(self, step=None):
+        self.reloads.append(step)
+        if self.reload_error:
+            raise EngineUnavailable(f"{self.name} is down")
+        if self.reload_refuse:
+            return {"outcome": "refused", "step": self.step}
+        if step is not None and step != self.step:
+            self.step = step
+            return {"outcome": "reloaded", "step": step}
+        return {"outcome": "unchanged", "step": self.step}
+
+
+def _router(n=3, **spec_kw):
+    spec_kw.setdefault("quarantine_after", 2)
+    spec_kw.setdefault("readmit_base_s", 0.01)
+    spec_kw.setdefault("readmit_cap_s", 0.02)
+    stubs = [StubHandle(f"e{i}") for i in range(n)]
+    r = Router(stubs, spec=RouterSpec(**spec_kw), log_fn=lambda s: None)
+    r.probe_all()          # first verdicts, no probe thread
+    return r, stubs
+
+
+# -- router dispatch ---------------------------------------------------------
+
+def test_route_picks_least_loaded():
+    r, stubs = _router(3)
+    stubs[0].queue_depth, stubs[2].queue_depth = 5, 3
+    r.probe_all()
+    out = r.route("generate", [1, 2])
+    assert out["engine"] == "e1"
+    assert stubs[1].served == 1
+
+
+def test_route_retries_on_other_engine_and_strikes():
+    r, stubs = _router(2, quarantine_after=1)
+    stubs[0].queue_depth = 0
+    stubs[1].queue_depth = 9          # e0 is preferred...
+    r.probe_all()
+    stubs[0].fail_request = True      # ...but crashed
+    out = r.route("generate", [1, 2])
+    assert out["engine"] == "e1"      # client never saw the failure
+    assert r.stats.retried == 1 and r.stats.completed == 1
+    # the failure was charged to e0 like a failed probe: quarantined
+    m = {m["name"]: m for m in r.members()}
+    assert m["e0"]["quarantined"] and not m["e1"]["quarantined"]
+
+
+def test_quarantine_and_readmission_cycle():
+    r, stubs = _router(2, quarantine_after=2)
+    stubs[0].fail_probe = True
+    r.probe_all()                     # strike 1
+    assert not r.members()[0]["quarantined"]
+    r.probe_all()                     # strike 2 -> quarantined
+    m = {m["name"]: m for m in r.members()}
+    assert m["e0"]["quarantined"] and r.stats.quarantines == 1
+    assert r.healthy_names() == ["e1"]
+    # benched: probes skip it until the Backoff delay passes
+    stubs[0].fail_probe = False
+    time.sleep(0.03)                  # > readmit_cap_s
+    r.probe_all()                     # readmission probe passes
+    m = {m["name"]: m for m in r.members()}
+    assert not m["e0"]["quarantined"] and r.stats.readmissions == 1
+    assert sorted(r.healthy_names()) == ["e0", "e1"]
+
+
+def test_all_engines_down_sheds_with_escalating_retry_after():
+    r, stubs = _router(2, quarantine_after=1)
+    for s in stubs:
+        s.fail_probe = True
+    r.probe_all()
+    delays = []
+    for _ in range(3):
+        with pytest.raises(Overloaded) as ei:
+            r.route("generate", [1])
+        delays.append(ei.value.retry_after)
+        assert r.stats.shed == len(delays)
+    # consecutive sheds escalate the hint (seeded-jitter Backoff is
+    # monotone across doublings at these magnitudes)
+    assert delays[0] < delays[2]
+
+
+def test_fleet_dispatch_fault_is_retried_not_surfaced():
+    r, stubs = _router(2, quarantine_after=1)
+    with inject(FaultSchedule.parse("fleet.dispatch@0:error")):
+        out = r.route("generate", [1, 2])
+    # the faulted attempt was charged to one engine; the request still
+    # completed on the other
+    assert out["engine"] in ("e0", "e1")
+    assert r.stats.retried == 1 and r.stats.completed == 1
+    assert sum(m["quarantined"] for m in r.members()) == 1
+
+
+def test_overload_is_load_not_failure():
+    r, stubs = _router(2, quarantine_after=1)
+    stubs[0].queue_depth = 0
+    stubs[1].queue_depth = 9
+    r.probe_all()
+    stubs[0].overloaded = True
+    out = r.route("generate", [1])
+    assert out["engine"] == "e1"
+    # no strike for shedding under load: e0 stays dispatchable
+    assert not any(m["quarantined"] for m in r.members())
+
+
+# -- rollout state machine (stub handles, ticks driven by hand) --------------
+
+def _controller(ws, n=3, **ro_kw):
+    ro_kw.setdefault("window_s", 0.01)
+    r, stubs = _router(n, quarantine_after=1)
+    ctrl = RolloutController(r, ws, spec=RolloutSpec(**ro_kw),
+                             log_fn=lambda s: None)
+    # arm without the controller thread: ticks are driven by the test
+    ctrl.pinned_step = 1
+    ctrl._fp = ctrl.mgr.fingerprint()
+    return ctrl, r, stubs
+
+
+def test_healthy_rollout_canaries_one_then_promotes():
+    _, params = None, {"w": np.ones((2,), np.float32)}
+    with tempfile.TemporaryDirectory() as ws:
+        mgr = CheckpointManager(ws, log_fn=lambda s: None)
+        _save(mgr, 1, params)
+        ctrl, r, stubs = _controller(ws)
+        ctrl.tick()
+        assert ctrl.state == "OBSERVE"        # nothing new
+        _save(mgr, 2, params)
+        ctrl.tick()
+        assert ctrl.state == "CANARY" and ctrl.canaries == 1
+        # exactly ONE engine carries the new step during the window
+        assert sum(1 for s in stubs if s.step == 2) == 1
+        time.sleep(0.02)                      # window_s elapsed
+        ctrl.tick()
+        assert ctrl.state == "OBSERVE" and ctrl.promotions == 1
+        assert ctrl.pinned_step == 2
+        assert all(s.step == 2 for s in stubs)
+
+
+def test_unhealthy_rollout_rolls_back_and_is_not_retried():
+    params = {"w": np.ones((2,), np.float32)}
+    with tempfile.TemporaryDirectory() as ws:
+        mgr = CheckpointManager(ws, log_fn=lambda s: None)
+        _save(mgr, 1, params)
+        ctrl, r, stubs = _controller(ws)
+        _save(mgr, 2, params, verdict="diverged")
+        ctrl.tick()
+        assert ctrl.state == "CANARY"
+        assert sum(1 for s in stubs if s.step == 2) == 1
+        time.sleep(0.02)
+        ctrl.tick()
+        assert ctrl.rollbacks == 1 and ctrl.promotions == 0
+        assert ctrl.pinned_step == 1
+        # the canary was restored: nobody serves the bad step
+        assert all(s.step == 1 for s in stubs)
+        # the rejected fingerprint is remembered, not re-canaried
+        for _ in range(3):
+            ctrl.tick()
+        assert ctrl.canaries == 1
+        # a NEW save (new fingerprint) is eligible again
+        _save(mgr, 3, params)
+        ctrl.tick()
+        assert ctrl.state == "CANARY" and ctrl.canaries == 2
+
+
+def test_canary_dies_mid_canary_rolls_back_without_deadlock():
+    params = {"w": np.ones((2,), np.float32)}
+    with tempfile.TemporaryDirectory() as ws:
+        mgr = CheckpointManager(ws, log_fn=lambda s: None)
+        _save(mgr, 1, params)
+        ctrl, r, stubs = _controller(ws, window_s=60.0)  # long window
+        _save(mgr, 2, params)
+        ctrl.tick()
+        assert ctrl.state == "CANARY"
+        victim = next(s for s in stubs if s.step == 2)
+        victim.fail_probe = True
+        victim.reload_error = True    # even the rollback reload fails
+        r.probe_all()                 # quarantine_after=1 -> benched
+        ctrl.tick()                   # detects the dead canary
+        assert ctrl.state == "OBSERVE" and ctrl.rollbacks == 1
+        assert ctrl.pinned_step == 1 and ctrl.canary is None
+        # the fleet keeps serving on the survivors
+        out = r.route("generate", [1])
+        assert out["engine"] != victim.name
+
+
+def test_newer_fingerprint_mid_canary_restarts_on_newest():
+    params = {"w": np.ones((2,), np.float32)}
+    with tempfile.TemporaryDirectory() as ws:
+        mgr = CheckpointManager(ws, log_fn=lambda s: None)
+        _save(mgr, 1, params)
+        ctrl, r, stubs = _controller(ws, window_s=60.0)
+        _save(mgr, 2, params)
+        ctrl.tick()
+        assert ctrl.state == "CANARY" and ctrl.target_step == 2
+        _save(mgr, 3, params)         # newer checkpoint lands mid-canary
+        ctrl.tick()
+        assert ctrl.canary_restarts == 1
+        assert ctrl.state == "CANARY" and ctrl.target_step == 3
+        # still at most one engine off the pinned step
+        assert sum(1 for s in stubs if s.step != 1) == 1
+        time.sleep(0.02)
+        # the abandoned step 2 was never promoted anywhere
+        assert all(s.step in (1, 3) for s in stubs)
+
+
+def test_rollout_fault_mid_canary_aborts_safely():
+    params = {"w": np.ones((2,), np.float32)}
+    with tempfile.TemporaryDirectory() as ws:
+        mgr = CheckpointManager(ws, log_fn=lambda s: None)
+        _save(mgr, 1, params)
+        ctrl, r, stubs = _controller(ws, window_s=60.0)
+        _save(mgr, 2, params)
+        ctrl.tick()
+        assert ctrl.state == "CANARY"
+        with inject(FaultSchedule.parse("fleet.rollout@0:error")):
+            ctrl.tick()               # faulted tick -> rollback, never die
+        assert ctrl.state == "OBSERVE" and ctrl.rollbacks == 1
+        assert ctrl.pinned_step == 1 and all(s.step == 1 for s in stubs)
+
+
+def test_torn_target_is_a_counted_refusal():
+    params = {"w": np.ones((2,), np.float32)}
+    with tempfile.TemporaryDirectory() as ws:
+        mgr = CheckpointManager(ws, log_fn=lambda s: None)
+        _save(mgr, 1, params)
+        ctrl, r, stubs = _controller(ws)
+        for s in stubs:
+            s.reload_refuse = True    # target never lands anywhere
+        _save(mgr, 2, params)
+        ctrl.tick()
+        assert ctrl.state == "OBSERVE" and ctrl.refusals == 1
+        assert ctrl.canaries == 0 and ctrl.pinned_step == 1
+        ctrl.tick()                   # rejected fp: no retry loop
+        assert ctrl.refusals == 1
+
+
+# -- honest /healthz ---------------------------------------------------------
+
+def test_engine_health_degrades_on_failure_streak():
+    net, params = _net_and_params()
+    eng = InferenceEngine(net, ServeSpec(degraded_after=3),
+                          params=params, log_fn=lambda s: None)
+    assert eng.health()["ok"]
+    for _ in range(3):
+        eng.stats.observe_batch_failure()
+    h = eng.health()
+    assert not h["ok"] and "consecutive failed batches" in \
+        " ".join(h["reasons"])
+    # any successful batch resets the streak
+    eng.stats.observe_batch(1, 1)
+    assert eng.health()["ok"]
+
+
+def test_engine_health_degrades_on_stale_params():
+    net, params = _net_and_params()
+    bad = dict(params)
+    k = next(iter(bad))
+    bad[k] = np.zeros(np.asarray(bad[k]).shape + (2,), np.float32)
+    with tempfile.TemporaryDirectory() as ws:
+        mgr = CheckpointManager(ws, max_to_keep=10,
+                                log_fn=lambda s: None)
+        _save(mgr, 1, params)
+        eng = InferenceEngine(net, ServeSpec(), workspace=ws,
+                              log_fn=lambda s: None)
+        eng.load()
+        assert eng.health()["ok"]
+        _save(mgr, 2, bad)            # geometry mismatch -> failed swap
+        assert eng.poll_reload() == "failed"
+        h = eng.health()
+        assert not h["ok"] and "stale" in " ".join(h["reasons"])
+        # a later good save clears the degradation
+        _save(mgr, 3, params)
+        assert eng.poll_reload() == "reloaded"
+        assert eng.health()["ok"]
+
+
+def test_healthz_endpoint_returns_503_when_degraded():
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    net, params = _net_and_params()
+    eng = InferenceEngine(net, ServeSpec(degraded_after=2),
+                          params=params, log_fn=lambda s: None)
+    srv = InferenceServer(eng, port=0, warmup_modes=(),
+                          log_fn=lambda s: None)
+    srv.start()
+    try:
+        host, port = srv.address
+        url = f"http://{host}:{port}/healthz"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            assert r.status == 200 and _json.loads(r.read())["ok"]
+        eng.stats.observe_batch_failure()
+        eng.stats.observe_batch_failure()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url, timeout=10)
+        assert ei.value.code == 503
+        body = _json.loads(ei.value.read())
+        assert body["status"] == "degraded" and body["reasons"]
+    finally:
+        srv.stop()
+
+
+def test_pinned_engine_never_self_reloads():
+    net, params = _net_and_params()
+    p2 = jax.tree_util.tree_map(lambda a: a * 2.0, params)
+    with tempfile.TemporaryDirectory() as ws:
+        mgr = CheckpointManager(ws, max_to_keep=10,
+                                log_fn=lambda s: None)
+        _save(mgr, 1, params)
+        eng = InferenceEngine(net, ServeSpec(), workspace=ws,
+                              log_fn=lambda s: None, pinned=True)
+        assert eng.load() == 1
+        _save(mgr, 2, p2)
+        assert eng.poll_reload() == "pinned"    # the poll is a no-op
+        assert eng.params_step == 1
+        # only the explicit command channel moves a pinned engine
+        assert eng.reload_to(2) == "reloaded"
+        assert eng.params_step == 2
+
+
+# -- real-engine integration (one compiled fleet, one bucket) ----------------
+
+def test_reload_fault_on_canary_keeps_fleet_pinned_and_serving():
+    """ISSUE 7 rollout edge: an injected `serve.reload` fault on the
+    canary's reload must leave the whole fleet on the old fingerprint
+    with ZERO failed user requests — the canary mechanism absorbs the
+    fault instead of spreading it."""
+    net, params = _net_and_params()
+    spec = ServeSpec(buckets=((2, 6),), max_new_tokens=2,
+                     batch_window_s=0.005, request_timeout_s=20.0)
+    with tempfile.TemporaryDirectory() as ws:
+        mgr = CheckpointManager(ws, max_to_keep=10,
+                                log_fn=lambda s: None)
+        _save(mgr, 1, params)
+        fleet = EngineFleet.local(
+            net, spec, 2, workspace=ws, params=params,
+            router_spec=RouterSpec(probe_period_s=0.05,
+                                   quarantine_after=1,
+                                   readmit_base_s=0.05),
+            rollout_spec=RolloutSpec(poll_s=0.05, window_s=0.1),
+            log_fn=lambda s: None)
+        # pinned fleet members never poll-reload, so the FIRST
+        # serve.reload visit in this process is the canary's reload_to
+        with inject(FaultSchedule.parse("serve.reload@0:error")):
+            fleet.start()
+            try:
+                assert fleet.rollout.pinned_step == 1
+                prompt = np.arange(1, 5, dtype=np.int32)
+                assert fleet.generate(prompt)["step"] == 1
+                _save(mgr, 2, params)
+                deadline = time.time() + 15
+                while time.time() < deadline and \
+                        fleet.rollout.refusals == 0 and \
+                        fleet.rollout.rollbacks == 0:
+                    fleet.generate(prompt)
+                    time.sleep(0.02)
+                ro = fleet.rollout.snapshot()
+                assert ro["refusals"] + ro["rollbacks"] == 1
+                assert ro["promotions"] == 0 and ro["pinned_step"] == 1
+                # the fleet never left the old fingerprint...
+                steps = [fleet.router.handle_for(n).engine.params_step
+                         for n in fleet.router.names()]
+                assert steps == [1, 1]
+                # ...and not one user request failed along the way
+                assert fleet.router.stats.failed == 0
+                assert fleet.generate(prompt)["step"] == 1
+            finally:
+                fleet.stop()
