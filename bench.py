@@ -702,6 +702,241 @@ def bench_fleet_smoke(n_clients=6, reqs_per_client=6, out=None):
     return result
 
 
+def bench_cb_smoke(n_requests=64, n_long=3, out=None):
+    """ISSUE 8 acceptance: continuous batching vs the static bucket
+    path under the same mixed load, over real HTTP.  61 shorts
+    (max_new=2) + 3 longs (max_new=256) hit each server; the run
+    FAILS (raises) unless:
+      * on the cb leg, at least one short request that was submitted
+        AFTER a long generation produced its first streamed token
+        completes BEFORE that long generation finishes (no
+        head-of-line blocking);
+      * cb p95 <= 0.5x static p95 (shorts no longer pay for the
+        batch-mate's full 256-token decode);
+      * both legs compile O(1) programs at warmup and ZERO after
+        (static: one bucket program; cb: one prefill + one decode).
+    Records p50/p95/p99, decode tok/s, slot occupancy, block-pool
+    utilization, and compile counts for both paths; `out` writes the
+    JSON line as well (scripts/serve_smoke.sh -> BENCH_pr8.json).
+    The model is bench-tiny: the subject is the scheduler, not the
+    matmuls."""
+    import json as _json
+    import queue as _queue
+    import threading
+    import urllib.request
+
+    import jax
+
+    from singa_tpu.core.net import build_net
+    from singa_tpu.models.transformer import transformer_lm
+    from singa_tpu.serve import InferenceEngine, InferenceServer, ServeSpec
+
+    vocab, seq = 64, 16
+    cfg = transformer_lm(vocab_size=vocab, num_layers=2, embed_dim=32,
+                         num_heads=4, head_dim=8, seq_len=seq,
+                         batchsize=2)
+    net = build_net(cfg, "kTest",
+                    {"data": {"input": (seq,), "target": (seq,)}})
+    params = net.init_params(jax.random.PRNGKey(0))
+
+    n_short = n_requests - n_long
+    # a 1024-token horizon puts the static path's pay-for-max cost in
+    # real decode compute (a 2-token request still rides a 1024-step
+    # scan), not per-call overhead — the regime the gate is about
+    max_new_long = 1024
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, vocab, int(rng.integers(1, seq + 1)))
+               .tolist() for _ in range(n_requests)]
+
+    def run_leg(spec, streaming):
+        engine = InferenceEngine(net, spec, params=params,
+                                 log_fn=lambda s: None)
+        warm = engine.warmup()
+        server = InferenceServer(engine, port=0, log_fn=lambda s: None)
+        server.start()
+        host, port = server.address
+        url = f"http://{host}:{port}"
+
+        def post(payload, timeout=120):
+            req = urllib.request.Request(
+                f"{url}/generate", data=_json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return _json.loads(r.read())
+
+        errors, lat = [], [None] * n_requests
+        long_first_tok = [None] * n_long   # monotonic, per long
+        long_done = [None] * n_long
+        short_span = [None] * n_short      # (t_submit, t_done)
+        t_base = time.monotonic()
+
+        def long_client(j):
+            try:
+                body = {"tokens": prompts[j], "timeout": 120,
+                        "max_new": max_new_long}
+                t0 = time.monotonic()
+                if streaming:
+                    body["stream"] = True
+                    req = urllib.request.Request(
+                        f"{url}/generate",
+                        data=_json.dumps(body).encode(),
+                        headers={"Content-Type": "application/json"})
+                    ntok = 0
+                    with urllib.request.urlopen(req, timeout=120) as r:
+                        for ln in r:
+                            if not ln.strip():
+                                continue
+                            ev = _json.loads(ln)
+                            if "error" in ev and "done" not in ev:
+                                raise RuntimeError(ev["error"])
+                            if "token" in ev:
+                                ntok += 1
+                                if long_first_tok[j] is None:
+                                    long_first_tok[j] = time.monotonic()
+                            if ev.get("done"):
+                                assert len(ev["tokens"]) == ntok
+                else:
+                    outp = post(body)
+                    assert len(outp["tokens"]) == max_new_long
+                    long_first_tok[j] = t0   # no stream: submit time
+                long_done[j] = time.monotonic()
+                lat[j] = long_done[j] - t0
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(f"long[{j}]: {e!r}")
+
+        work: "_queue.Queue" = _queue.Queue()
+        for i in range(n_short):
+            work.put(i)
+
+        def short_worker():
+            while True:
+                try:
+                    i = work.get_nowait()
+                except _queue.Empty:
+                    return
+                try:
+                    t0 = time.monotonic()
+                    outp = post({"tokens": prompts[n_long + i],
+                                 "timeout": 120, "max_new": 2})
+                    t1 = time.monotonic()
+                    assert len(outp["tokens"]) == 2
+                    lat[n_long + i] = t1 - t0
+                    short_span[i] = (t0, t1)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"short[{i}]: {e!r}")
+
+        longs = [threading.Thread(target=long_client, args=(j,))
+                 for j in range(n_long)]
+        for t in longs:
+            t.start()
+        # shorts join a load that is already decoding the longs; 8
+        # closed-loop workers keep the static queue several batches
+        # deep without turning the cb leg's own admission drain into
+        # the bottleneck
+        time.sleep(0.05)
+        workers = [threading.Thread(target=short_worker)
+                   for _ in range(8)]
+        for t in workers:
+            t.start()
+        for t in workers + longs:
+            t.join()
+        wall = time.monotonic() - t_base
+
+        with urllib.request.urlopen(f"{url}/stats", timeout=10) as r:
+            snap = _json.loads(r.read())
+        server.stop()
+        return {"errors": errors, "lat": lat, "snap": snap,
+                "warm": warm, "wall": wall,
+                "long_first_tok": long_first_tok,
+                "long_done": long_done, "short_span": short_span}
+
+    st_spec = ServeSpec(buckets=((2, seq),), max_new_tokens=max_new_long,
+                        temperature=0.0, batch_window_s=0.005,
+                        request_timeout_s=120.0, reload_poll_s=100.0)
+    cb_spec = ServeSpec(buckets=((2, seq),), max_new_tokens=max_new_long,
+                        temperature=0.0, request_timeout_s=120.0,
+                        reload_poll_s=100.0,
+                        cb="on", cb_slots=8, cb_block_len=4)
+    st = run_leg(st_spec, streaming=False)
+    cb = run_leg(cb_spec, streaming=True)
+
+    def quantiles(lat):
+        a = np.sort(np.asarray([v for v in lat if v is not None]))
+        return {q: float(a[min(int(q / 100 * a.size), a.size - 1)])
+                for q in (50, 95, 99)}
+
+    failures = []
+    for leg, name in ((st, "static"), (cb, "cb")):
+        if leg["errors"]:
+            failures.append(f"{name} client errors: {leg['errors']}")
+        if any(v is None for v in leg["lat"]):
+            failures.append(f"{name}: dropped requests")
+        if leg["snap"]["compiles"] != leg["warm"]:
+            failures.append(
+                f"{name} recompiled after warmup: "
+                f"{leg['snap']['compiles']} != {leg['warm']}")
+    # the tentpole behavior: a short admitted after a long's first
+    # streamed token finishes while that long is still decoding
+    overlapped = any(
+        ft is not None and dn is not None and sp is not None
+        and sp[0] > ft and sp[1] < dn
+        for ft, dn in zip(cb["long_first_tok"], cb["long_done"])
+        for sp in cb["short_span"])
+    if not overlapped:
+        failures.append("no short request completed while a long "
+                        "generation was still decoding")
+    stq, cbq = quantiles(st["lat"]), quantiles(cb["lat"])
+    if not failures and cbq[95] > 0.5 * stq[95]:
+        failures.append(f"cb p95 {cbq[95] * 1e3:.1f}ms > 0.5x static "
+                        f"p95 {stq[95] * 1e3:.1f}ms")
+    if failures:
+        raise RuntimeError("cb smoke FAILED: " + "; ".join(failures))
+
+    result = {
+        "metric": "cb_smoke_p95_ratio",
+        "value": round(cbq[95] / stq[95], 4),
+        "unit": "cb_p95_over_static_p95",
+        "gate": 0.5,
+        "requests": n_requests,
+        "long_requests": n_long,
+        "max_new_long": max_new_long,
+        "short_completed_while_long_decoding": overlapped,
+        "static": {
+            "p50_ms": round(stq[50] * 1e3, 3),
+            "p95_ms": round(stq[95] * 1e3, 3),
+            "p99_ms": round(stq[99] * 1e3, 3),
+            "wall_s": round(st["wall"], 3),
+            "tokens_per_s_p50": st["snap"]["p50_tokens_per_s"],
+            "generated_tokens": st["snap"]["generated_tokens"],
+            "batch_occupancy": st["snap"]["batch_occupancy"],
+            "compiles_warmup": st["warm"],
+            "compiles_total": st["snap"]["compiles"],
+        },
+        "cb": {
+            "p50_ms": round(cbq[50] * 1e3, 3),
+            "p95_ms": round(cbq[95] * 1e3, 3),
+            "p99_ms": round(cbq[99] * 1e3, 3),
+            "wall_s": round(cb["wall"], 3),
+            "tokens_per_s_p50": cb["snap"]["p50_tokens_per_s"],
+            "generated_tokens": cb["snap"]["generated_tokens"],
+            "slot_occupancy": cb["snap"]["cb_slot_occupancy"],
+            "block_utilization": cb["snap"]["cb_block_utilization"],
+            "decode_steps": cb["snap"]["cb_steps"],
+            "slots": cb_spec.cb_slots,
+            "block_len": cb_spec.cb_block_len,
+            "pool_blocks": cb_spec.cb_pool_blocks,
+            "compiles_warmup": cb["warm"],
+            "compiles_total": cb["snap"]["compiles"],
+        },
+        "backend": jax.default_backend(),
+    }
+    line = json.dumps(result)
+    if out:
+        with open(out, "w") as f:
+            f.write(line + "\n")
+    return result
+
+
 def bench_obs_overhead(batch_size=64, steps=96, scan_chunk=8,
                        reps=3, out=None):
     """ISSUE 6 acceptance: `--obs on` must cost < 3% wall time on the
@@ -804,6 +1039,12 @@ def main() -> None:
         if "--out" in sys.argv:
             out = sys.argv[sys.argv.index("--out") + 1]
         print(json.dumps(bench_fleet_smoke(out=out)))
+        return
+    if "--cb-smoke" in sys.argv:
+        out = None
+        if "--out" in sys.argv:
+            out = sys.argv[sys.argv.index("--out") + 1]
+        print(json.dumps(bench_cb_smoke(out=out)))
         return
     if "--obs-overhead" in sys.argv:
         out = None

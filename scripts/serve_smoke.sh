@@ -69,3 +69,41 @@ python -m singa_tpu.main serve -model_conf examples/transformer/lm.conf \
     | grep -E '"completed": 5' > /dev/null || {
         echo "SERVE SMOKE CLI LEG FAILED"; exit 1; }
 echo "SERVE SMOKE CLI PASS"
+
+# Leg 4 (ISSUE 8 acceptance): continuous batching vs the static bucket
+# path under the same mixed load over real HTTP.  bench_cb_smoke raises
+# (and this script fails) unless a short request completes while a long
+# generation still decodes, cb p95 <= 0.5x static p95, and both legs
+# compile O(1) programs at warmup with zero recompiles after.  Writes
+# BENCH_pr8.json.
+python bench.py --cb-smoke --out BENCH_pr8.json
+
+python - <<'EOF'
+import json
+with open("BENCH_pr8.json") as f:
+    d = json.loads(f.read())
+assert d["value"] <= d["gate"], f"cb p95 ratio {d['value']} > gate {d['gate']}"
+assert d["short_completed_while_long_decoding"] is True, d
+for leg in ("static", "cb"):
+    for k in ("p50_ms", "p95_ms", "p99_ms", "tokens_per_s_p50"):
+        v = d[leg][k]
+        assert isinstance(v, (int, float)), f"BENCH_pr8.json: {leg}.{k} missing/null: {v}"
+    assert d[leg]["compiles_total"] == d[leg]["compiles_warmup"], d[leg]
+for k in ("slot_occupancy", "block_utilization"):
+    assert isinstance(d["cb"][k], (int, float)) and 0 < d["cb"][k] <= 1, (k, d["cb"][k])
+assert d["cb"]["compiles_warmup"] == 2, d["cb"]  # one prefill + one decode
+print(f"BENCH_pr8.json ok: cb p95 {d['cb']['p95_ms']}ms vs static p95 "
+      f"{d['static']['p95_ms']}ms (ratio {d['value']}), slot occupancy "
+      f"{d['cb']['slot_occupancy']}, block utilization {d['cb']['block_utilization']}")
+EOF
+echo "CB SMOKE PASS: short completed mid-long-decode, cb p95 <= 0.5x static,"
+echo "  O(1) warmup compiles, zero recompiles after"
+
+# Leg 5: the cb CLI surface — the same serve --smoke driver through the
+# continuous-batching path (scheduler slots instead of buckets)
+python -m singa_tpu.main serve -model_conf examples/transformer/lm.conf \
+    --smoke 5 \
+    --serve_spec 'buckets=2x16,max_new_tokens=6,cb=on,cb_slots=2,cb_block_len=4' \
+    | grep -E '"completed": 5' > /dev/null || {
+        echo "SERVE SMOKE CB CLI LEG FAILED"; exit 1; }
+echo "SERVE SMOKE CB CLI PASS"

@@ -16,7 +16,10 @@ Serving (the inference tier, singa_tpu/serve/):
         --workspace ws [--port 8000] [--serve_spec 'buckets=4x16/8x32,...']
 follows the trainer's checkpoints in the workspace (hot-reload) and
 serves /generate, /predict, /stats, /metrics, /healthz over stdlib
-HTTP.  `serve --fleet N` runs N pinned engine workers behind a
+HTTP.  With `cb=on` in the serve spec, /generate runs continuous
+batching over a paged KV cache and streams tokens as produced when
+the request body carries `"stream": true` (docs/SERVING.md).
+`serve --fleet N` runs N pinned engine workers behind a
 health-driven router with canary rollout/auto-rollback;
 `serve --fleet_hostfile h` adopts already-running `serve --pinned`
 processes as the fleet.  Both subcommands take `--obs on
@@ -160,8 +163,12 @@ def make_serve_argparser() -> argparse.ArgumentParser:
                          "over the ServeSpec fields, buckets as "
                          "BxP '/' entries, e.g. 'buckets=1x16/4x32,"
                          "max_new_tokens=32,eos_id=2,"
-                         "batch_window_s=0.005' "
-                         "(singa_tpu/serve/engine.py)")
+                         "batch_window_s=0.005'; cb=on enables "
+                         "continuous batching over the paged KV cache "
+                         "(cb_slots, cb_block_len, cb_blocks, "
+                         "cb_prompt_cap) with streaming POST "
+                         "/generate (singa_tpu/serve/engine.py, "
+                         "docs/SERVING.md)")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8000,
                     help="HTTP port (0 = ephemeral)")
@@ -263,16 +270,19 @@ def serve_main(argv) -> int:
                     import numpy as np
                     rng = np.random.default_rng(args.seed)
                     vocab = _serve_vocab(net)
+                    cap = (spec.cb_max_prompt_len if spec.cb_on
+                           else spec.max_prompt_len)
                     for i in range(args.smoke):
-                        plen = int(rng.integers(
-                            1, spec.max_prompt_len + 1))
+                        plen = int(rng.integers(1, cap + 1))
                         prompt = rng.integers(0, vocab,
                                               plen).astype("int32")
                         out = server.generate(prompt)
+                        shape = (f"finish {out['finish']}"
+                                 if "finish" in out
+                                 else f"bucket {out.get('bucket')}")
                         log(f"smoke {i}: plen={plen} -> "
                             f"{len(out['tokens'])} tokens "
-                            f"(step {out['step']}, "
-                            f"bucket {out['bucket']})")
+                            f"(step {out['step']}, {shape})")
                     print(_json.dumps(server.snapshot()))
                     return 0
                 import time

@@ -154,6 +154,143 @@ def forward_cached(net: NeuralNet, params, tokens: jnp.ndarray,
     return logits.astype(jnp.float32), new_cache
 
 
+def _attn_paged(layer, params, x, entry: CacheEntry, tables,
+                ntoks) -> Tuple[jnp.ndarray, CacheEntry]:
+    """Single-token decode attention over a block/paged KV pool.
+
+    `x` is (1, S, E): the serving tier's S decode slots ride the SEQ
+    axis of a batch-1 chunk, so every position-wise layer (embed,
+    rmsnorm, ffn, lmhead) and `layer.qkv`'s per-position RoPE treat a
+    slot exactly like a sequence position — `ntoks` (S,) int32 is both
+    the per-slot absolute position vector RoPE rotates by and the
+    per-slot key-visibility horizon.  The slots never attend each
+    other: attention below is per-slot against that slot's own blocks.
+
+    `entry` holds the layer's {"k","v"} pools, each (num_blocks, Hkv,
+    block_len, D); `tables` (S, T) int32 maps slot s's logical block t
+    to a pool index (block 0 = null: inactive slots and table tails
+    point there; its contents are never visible through the mask).
+    Token position p of slot s lives at pool[tables[s, p // bl], :,
+    p % bl] — flat gathered position p equals absolute position p, so
+    the score row matches `_attn_cached`'s contiguous row entry for
+    entry, and with masked lanes contributing exact zeros after
+    softmax the paged read is bit-identical to the contiguous one
+    (the parity tests pin this).
+
+    Write-before-read: the new K/V is scattered at position ntoks[s]
+    first, then the gather reads `kpos <= ntoks[s]` — the same
+    self-inclusive causal horizon as `_attn_cached` at T=1."""
+    assert layer.causal, f"{layer.name}: decode requires causal attention"
+    _, s, _ = x.shape
+    bl = entry["k"].shape[2]
+    q, k, v = layer.qkv(params, x, ntoks, _CTX)    # (1,H,S,D)/(1,Hkv,S,D)
+
+    bidx = tables[jnp.arange(s), ntoks // bl]      # (S,) pool block
+    off = ntoks % bl                               # (S,) offset in block
+    k_new = k[0].transpose(1, 0, 2)                # (S, Hkv, D)
+    v_new = v[0].transpose(1, 0, 2)
+    # advanced indices (S,) around the ":" land the (S, Hkv, D) update
+    # at [block, :, offset]; inactive slots write the null block
+    k_pool = entry["k"].at[bidx, :, off].set(k_new.astype(entry["k"].dtype))
+    v_pool = entry["v"].at[bidx, :, off].set(v_new.astype(entry["v"].dtype))
+
+    t = tables.shape[1]
+    kk = k_pool[tables]                            # (S, T, Hkv, bl, D)
+    vv = v_pool[tables]
+    kk = kk.transpose(0, 2, 1, 3, 4).reshape(
+        s, layer.kv_heads, t * bl, layer.head_dim).astype(q.dtype)
+    vv = vv.transpose(0, 2, 1, 3, 4).reshape(
+        s, layer.kv_heads, t * bl, layer.head_dim).astype(q.dtype)
+
+    qs = q[0].transpose(1, 0, 2)[:, :, None, :]    # (S, H, 1, D)
+    kpos = jnp.arange(t * bl)[None, :]             # (1, T*bl)
+    allowed = kpos <= ntoks[:, None]               # (S, T*bl)
+    groups = layer.heads // layer.kv_heads
+    if groups == 1:
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qs, kk,
+                            preferred_element_type=jnp.float32)
+        scores = scores / jnp.sqrt(jnp.float32(layer.head_dim))
+        scores = jnp.where(allowed[:, None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vv.dtype), vv)
+    else:
+        qg = qs.reshape(s, layer.kv_heads, groups, 1, layer.head_dim)
+        scores = jnp.einsum("bhgqd,bhkd->bhgqk", qg, kk,
+                            preferred_element_type=jnp.float32)
+        scores = scores / jnp.sqrt(jnp.float32(layer.head_dim))
+        scores = jnp.where(allowed[:, None, None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhgqk,bhkd->bhgqd", probs.astype(vv.dtype), vv)
+        out = out.reshape(s, layer.heads, 1, layer.head_dim)
+    out = out[:, :, 0, :].reshape(1, s, -1)        # back to (1, S, H*D)
+    out = layer._proj(params, layer.wo, out.astype(x.dtype), _CTX)
+    return out, {"k": k_pool, "v": v_pool}
+
+
+def forward_paged(net: NeuralNet, params, tokens: jnp.ndarray,
+                  pools: Cache, tables, ntoks
+                  ) -> Tuple[jnp.ndarray, Cache]:
+    """One decode step for S slots against the paged KV pool.
+    `tokens` (1, S) int32 — slot s's last sampled token on the seq
+    axis; `tables` (S, T) int32 block tables; `ntoks` (S,) int32
+    tokens already written per slot (= the incoming token's absolute
+    position).  Returns (logits (1, S, V) float32, updated pools)."""
+    full = net._resolve_params(params)
+    outputs: Dict[str, Any] = {}
+    new_pools: Cache = dict(pools)
+    logits = None
+    for idx, name in enumerate(net.topo):
+        layer = net.layers[name]
+        ltype = layer.cfg.type
+        srcs = [net._src_out(outputs, s, name) for s in layer.cfg.srclayers]
+        if ltype == "kSequenceData":
+            outputs[name] = {"input": tokens, "target": tokens}
+        elif ltype == "kSeqLabel":
+            outputs[name] = tokens
+        elif ltype == "kAttention":
+            out, new_pools[name] = _attn_paged(
+                layer, full, srcs[0], pools[name], tables, ntoks)
+            outputs[name] = out
+        elif ltype == "kLMHead":
+            outputs[name] = layer.apply(full, srcs, _CTX)
+            logits = outputs[name]
+        elif ltype == "kLMHeadLoss":
+            logits = layer.project_logits(full, srcs[0])
+            outputs[name] = logits
+        elif ltype == "kSoftmaxLoss":
+            outputs[name] = None
+        else:
+            ctx = Context(batch={}, train=False, rng=None, layer_index=idx,
+                          mesh=None, compute_dtype=None)
+            outputs[name] = layer.apply(full, srcs, ctx)
+    if logits is None:
+        raise ValueError("net has no kLMHead/kLMHeadLoss layer")
+    return logits.astype(jnp.float32), new_pools
+
+
+def scatter_prefill(pools: Cache, cache: Cache, table_row) -> Cache:
+    """Scatter a batch-1 contiguous prefill cache ((1, Hkv, P, D) per
+    layer, P a block_len multiple) into the paged pools at the blocks
+    named by `table_row` (P // block_len,) int32.  Table entries
+    beyond the slot's real reservation are 0: garbage from pad
+    positions lands in the null block, where no mask ever looks."""
+    out: Cache = {}
+    for name, entry in cache.items():
+        bl = pools[name]["k"].shape[2]
+        hkv, p, d = entry["k"].shape[1:]
+        nb = p // bl
+        kb = entry["k"][0].transpose(1, 0, 2).reshape(
+            nb, bl, hkv, d).transpose(0, 2, 1, 3)   # (nb, Hkv, bl, D)
+        vb = entry["v"][0].transpose(1, 0, 2).reshape(
+            nb, bl, hkv, d).transpose(0, 2, 1, 3)
+        out[name] = {
+            "k": pools[name]["k"].at[table_row].set(
+                kb.astype(pools[name]["k"].dtype)),
+            "v": pools[name]["v"].at[table_row].set(
+                vb.astype(pools[name]["v"].dtype))}
+    return out
+
+
 def _sample(logits: jnp.ndarray, key, temperature: float,
             top_k: int, top_p: float) -> jnp.ndarray:
     """logits: (B, V) -> (B,) int32.  temperature 0 = greedy."""

@@ -1,26 +1,39 @@
 """TPU-native inference serving tier (docs/SERVING.md).
 
 The first subsystem on the inference half of the north star: admit ->
-micro-batch -> compiled bucket program -> respond, following the
-trainer's checkpoints via atomic hot-reload.  Layers:
+batch -> compiled program -> respond, following the trainer's
+checkpoints via atomic hot-reload.  Layers:
 
-    engine.py   ServeSpec + InferenceEngine: AOT-compiled per-bucket
-                generate/predict programs, healthy-checkpoint load,
-                degrade-not-crash hot reload, pinned-fingerprint fleet
-                mode + explicit reload_to, honest health() verdicts
-    batcher.py  MicroBatcher: bounded-queue admission with Backoff
-                shedding, deadline expiry, smallest-admissible-bucket
-                coalescing with left-pad masking
-    server.py   InferenceServer: stdlib-HTTP + in-process frontends,
-                reload poll thread, /admin/reload command channel
-    stats.py    ServeStats: QPS, p50/p95 latency, occupancy, queue
-                depth, reload/shed counters (PipelineStats mold)
-    router.py   Router + engine handles: least-loaded healthy
-                dispatch, retry-on-other-engine, Backoff quarantine /
-                readmission, router-level shedding
-    fleet.py    EngineFleet + RolloutController + FleetServer:
-                N workers behind one router, canary rollout with
-                auto-rollback (OBSERVE -> CANARY -> PROMOTE/ROLLBACK)
+    engine.py    ServeSpec + InferenceEngine: AOT-compiled per-bucket
+                 generate/predict programs, healthy-checkpoint load,
+                 degrade-not-crash hot reload, pinned-fingerprint fleet
+                 mode + explicit reload_to, honest health() verdicts;
+                 cb=on adds the two continuous-batching programs
+                 (paged prefill + fixed-slot decode step)
+    batcher.py   MicroBatcher: bounded-queue admission with Backoff
+                 shedding, deadline expiry, smallest-admissible-bucket
+                 coalescing with left-pad masking (the static path;
+                 predict always rides here)
+    kvcache.py   PagedKVCache: fixed pool of (block, Hkv, block_len,
+                 D) KV blocks, per-slot block tables, refcounts, null
+                 block 0 — slot memory O(active tokens)
+    scheduler.py ContinuousScheduler + StreamTicket: admit a request
+                 into a free slot at any decode step, retire on
+                 EOS/max-new/deadline, free blocks immediately, ONE
+                 compiled decode program per step
+    server.py    InferenceServer: stdlib-HTTP + in-process frontends,
+                 reload poll thread, /admin/reload command channel,
+                 chunked-transfer streaming POST /generate under cb
+    stats.py     ServeStats: QPS, p50/p95 latency + queue-wait/
+                 service split, tok/s, occupancy (bucket and slot),
+                 reload/shed counters (PipelineStats mold)
+    router.py    Router + engine handles: least-loaded healthy
+                 dispatch, retry-on-other-engine (streams: only
+                 before the first byte), Backoff quarantine /
+                 readmission, router-level shedding
+    fleet.py     EngineFleet + RolloutController + FleetServer:
+                 N workers behind one router, canary rollout with
+                 auto-rollback, streaming passthrough
 
 Fault sites `serve.admit` / `serve.batch` / `serve.reload` /
 `fleet.dispatch` / `fleet.rollout` (utils.faults) make every
@@ -31,15 +44,18 @@ from .batcher import DeadlineExpired, MicroBatcher, Overloaded, Ticket
 from .engine import InferenceEngine, ServeSpec
 from .fleet import (EngineFleet, FleetServer, RolloutController,
                     RolloutSpec)
+from .kvcache import PagedKVCache
 from .router import (EngineUnavailable, HttpEngineHandle,
                      LocalEngineHandle, Router, RouterSpec,
                      RouterStats)
+from .scheduler import ContinuousScheduler, StreamTicket
 from .server import InferenceServer
 from .stats import ServeStats
 
-__all__ = ["DeadlineExpired", "EngineFleet", "EngineUnavailable",
-           "FleetServer", "HttpEngineHandle", "InferenceEngine",
-           "InferenceServer", "LocalEngineHandle", "MicroBatcher",
-           "Overloaded", "RolloutController", "RolloutSpec", "Router",
-           "RouterSpec", "RouterStats", "ServeSpec", "ServeStats",
+__all__ = ["ContinuousScheduler", "DeadlineExpired", "EngineFleet",
+           "EngineUnavailable", "FleetServer", "HttpEngineHandle",
+           "InferenceEngine", "InferenceServer", "LocalEngineHandle",
+           "MicroBatcher", "Overloaded", "PagedKVCache",
+           "RolloutController", "RolloutSpec", "Router", "RouterSpec",
+           "RouterStats", "ServeSpec", "ServeStats", "StreamTicket",
            "Ticket"]

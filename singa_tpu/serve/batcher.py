@@ -156,12 +156,18 @@ class MicroBatcher:
         fires; ValueError for an unservable prompt."""
         arr = np.asarray(tokens, np.int32).reshape(-1)
         if arr.size < 1:
+            self.stats.count("rejected")
             raise ValueError("empty prompt")
         if arr.size > self.spec.max_prompt_len:
+            # fail fast at admission (the HTTP layer's 400): an
+            # unservable prompt must not sit in the queue until its
+            # deadline turns it into a 504
+            self.stats.count("rejected")
             raise ValueError(
                 f"prompt length {arr.size} exceeds the largest bucket "
                 f"({self.spec.max_prompt_len}); not servable")
         if mode not in ("generate", "predict"):
+            self.stats.count("rejected")
             raise ValueError(f"unknown mode {mode!r}")
         if timeout is None:
             timeout = self.spec.request_timeout_s
@@ -273,6 +279,7 @@ class MicroBatcher:
     def _dispatch_batch(self, reqs: List[_Request],
                         bucket: Tuple[int, int]) -> None:
         b, p = bucket
+        t_disp = time.monotonic()
         try:
             faults.maybe_fault("serve.batch")
             # ONE read of the live tree: a concurrent hot-reload swap
@@ -306,10 +313,16 @@ class MicroBatcher:
                 toks = self._trim_eos(out[i])
                 result = {"tokens": toks, "step": step,
                           "bucket": [b, p]}
+                ntok = len(toks)
             else:
                 result = {"logprobs": out[i].tolist(), "step": step,
                           "bucket": [b, p]}
+                ntok = 0
             self.stats.observe_latency(now - r.t_submit)
+            # queue-wait = submit -> this dispatch; service = the
+            # batch's device time (shared across its requests)
+            self.stats.observe_request(t_disp - r.t_submit,
+                                       now - t_disp, ntok)
             r.ticket._resolve(result)
 
     def _trim_eos(self, row: np.ndarray) -> List[int]:

@@ -42,9 +42,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
-from ..models.generate import _sample, forward_cached, init_cache
+from ..models.generate import (_sample, forward_cached, forward_paged,
+                               init_cache, scatter_prefill)
 from ..utils import faults
 from ..utils.checkpoint import CheckpointManager
+from .kvcache import init_pools
 from .stats import ServeStats
 
 MODES = ("generate", "predict")
@@ -70,6 +72,17 @@ class ServeSpec:
     reload_poll_s: float = 1.0
     degraded_after: int = 3   # consecutive failed batches -> degraded
     seed: int = 0
+    # continuous batching (serve/scheduler.py): cb=on replaces the
+    # static generate buckets with a paged-KV slot scheduler.  The
+    # compiled geometry is (cb_slots, blocks-per-slot, cb_block_len,
+    # pool size) ONLY — exactly two programs (prefill + decode step)
+    # regardless of traffic mix, so the zero-recompile guarantee holds
+    cb: str = "off"           # "on" | "off"
+    cb_slots: int = 8         # concurrent decode slots (S)
+    cb_block_len: int = 16    # tokens per KV block
+    cb_blocks: int = 0        # pool size incl. null block; 0 = auto
+    cb_prompt_cap: int = 0    # longest admissible prompt; 0 = widest
+                              # bucket prompt_len
 
     def __post_init__(self):
         norm = []
@@ -93,10 +106,53 @@ class ServeSpec:
         if int(self.degraded_after) < 1:
             raise ValueError(f"degraded_after must be >= 1, got "
                              f"{self.degraded_after}")
+        if self.cb not in ("on", "off"):
+            raise ValueError(f"cb must be 'on' or 'off', got "
+                             f"{self.cb!r}")
+        if int(self.cb_slots) < 1 or int(self.cb_block_len) < 1:
+            raise ValueError("cb_slots and cb_block_len must be >= 1")
+        if int(self.cb_blocks) < 0 or int(self.cb_prompt_cap) < 0:
+            raise ValueError("cb_blocks and cb_prompt_cap must be "
+                             ">= 0 (0 = auto)")
 
     @property
     def max_prompt_len(self) -> int:
         return max(p for _, p in self.buckets)
+
+    # -- continuous-batching geometry (all derived, all static) -------------
+    @property
+    def cb_on(self) -> bool:
+        return self.cb == "on"
+
+    @property
+    def cb_prefill_len(self) -> int:
+        """Compiled prefill width P: the prompt cap rounded UP to a
+        block multiple (prefill scatters whole blocks)."""
+        cap = int(self.cb_prompt_cap) or self.max_prompt_len
+        bl = int(self.cb_block_len)
+        return -(-cap // bl) * bl
+
+    @property
+    def cb_max_prompt_len(self) -> int:
+        """Longest admissible prompt under cb (fail-fast bound)."""
+        return int(self.cb_prompt_cap) or self.max_prompt_len
+
+    @property
+    def cb_blocks_per_slot(self) -> int:
+        """Table width T: worst-case blocks one slot can ever hold
+        (full prefill + a full generation)."""
+        bl = int(self.cb_block_len)
+        return -(-(self.cb_prefill_len + int(self.max_new_tokens)) // bl)
+
+    @property
+    def cb_pool_blocks(self) -> int:
+        """Pool size incl. the null block.  Auto (cb_blocks=0) sizes
+        for every slot at worst case — exhaustion then needs an
+        explicit smaller cb_blocks (the shed tests use one)."""
+        n = int(self.cb_blocks)
+        if n == 0:
+            n = int(self.cb_slots) * self.cb_blocks_per_slot + 1
+        return n
 
     @property
     def max_batch(self) -> int:
@@ -141,6 +197,8 @@ class ServeSpec:
                 elif key == "eos_id":
                     kw[key] = None if val.lower() in ("none", "") \
                         else int(val)
+                elif "str" in str(types[key]):
+                    kw[key] = val.lower()
                 elif "float" in str(types[key]):
                     kw[key] = float(val)
                 else:
@@ -440,6 +498,132 @@ class InferenceEngine:
 
         return fn
 
+    # -- continuous-batching programs ---------------------------------------
+    def _build_cb_prefill(self):
+        """ONE compiled prefill at fixed (1, P): the prompt is
+        RIGHT-padded to P (the causal mask alone keeps pad keys out of
+        every real query's horizon; pad K/V garbage lands in reserved
+        or null blocks and is masked/overwritten downstream), runs
+        through the ordinary contiguous `forward_cached`, samples the
+        first token from the last REAL position, and scatters the
+        contiguous cache into the slot's pool blocks."""
+        net, spec = self.net, self.spec
+        p_len = spec.cb_prefill_len
+        temperature, top_k, top_p = (float(spec.temperature),
+                                     int(spec.top_k), float(spec.top_p))
+
+        def fn(params, pools, tokens, plen, row, key):
+            dtype = jax.tree_util.tree_leaves(params)[0].dtype
+            cache = init_cache(net, 1, p_len, dtype)
+            logits, cache = forward_cached(net, params, tokens, cache, 0)
+            last = jax.lax.dynamic_index_in_dim(logits[0], plen - 1,
+                                                axis=0, keepdims=True)
+            tok0 = _sample(last, key, temperature, top_k, top_p)[0]
+            return tok0, scatter_prefill(pools, cache, row)
+
+        return fn
+
+    def _build_cb_decode(self):
+        """ONE compiled decode step at fixed slot count S: every
+        active slot advances one token against its paged blocks
+        (forward_paged), one `_sample` call produces all S next
+        tokens.  Join/retire is pure host bookkeeping in the
+        scheduler — the program never changes shape."""
+        net, spec = self.net, self.spec
+        temperature, top_k, top_p = (float(spec.temperature),
+                                     int(spec.top_k), float(spec.top_p))
+
+        def fn(params, pools, tokens, ntoks, tables, key):
+            logits, pools = forward_paged(net, params, tokens[None],
+                                          pools, tables, ntoks)
+            nxt = _sample(logits[0], key, temperature, top_k, top_p)
+            return nxt, pools
+
+        return fn
+
+    def _pools_spec(self):
+        dtype = jax.tree_util.tree_leaves(self._params)[0].dtype
+        pools = init_pools(self.net, self.spec.cb_pool_blocks,
+                           self.spec.cb_block_len, dtype)
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), pools)
+
+    def _compile_cb(self, which: str):
+        """AOT-compile the cb prefill or decode program (same lock,
+        same `compiles` accounting as `_compile` — the counter still
+        moves ONLY inside the two compile paths).  Pools are donated:
+        the scheduler threads the returned pools into the next call,
+        so the pool never exists twice on device."""
+        spec = self.spec
+        key = (f"cb_{which}", spec.cb_slots, spec.cb_blocks_per_slot)
+        got = self._compiled.get(key)
+        if got is not None:
+            return got
+        with self._compile_lock:
+            got = self._compiled.get(key)
+            if got is not None:
+                return got
+            if self._params is None:
+                raise RuntimeError("engine has no params; call load()")
+            with obs.span("engine.compile", mode=f"cb_{which}",
+                          slots=spec.cb_slots,
+                          blocks=spec.cb_pool_blocks):
+                p_spec = jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                    self._params)
+                pools = self._pools_spec()
+                rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+                if which == "prefill":
+                    fn = self._build_cb_prefill()
+                    tok = jax.ShapeDtypeStruct(
+                        (1, spec.cb_prefill_len), jnp.int32)
+                    plen = jax.ShapeDtypeStruct((), jnp.int32)
+                    row = jax.ShapeDtypeStruct(
+                        (spec.cb_prefill_len // spec.cb_block_len,),
+                        jnp.int32)
+                    compiled = jax.jit(fn, donate_argnums=(1,)).lower(
+                        p_spec, pools, tok, plen, row, rng).compile()
+                elif which == "decode":
+                    fn = self._build_cb_decode()
+                    s = spec.cb_slots
+                    tok = jax.ShapeDtypeStruct((s,), jnp.int32)
+                    ntoks = jax.ShapeDtypeStruct((s,), jnp.int32)
+                    tables = jax.ShapeDtypeStruct(
+                        (s, spec.cb_blocks_per_slot), jnp.int32)
+                    compiled = jax.jit(fn, donate_argnums=(1,)).lower(
+                        p_spec, pools, tok, ntoks, tables, rng).compile()
+                else:
+                    raise ValueError(f"unknown cb program {which!r}")
+            self.stats.count("compiles")
+            self._compiled[key] = compiled
+            return compiled
+
+    def run_cb_prefill(self, params, pools, tokens: np.ndarray,
+                       plen: int, row: np.ndarray):
+        """One slot prefill: `tokens` (1, P) int32 RIGHT-padded,
+        `row` the first P//block_len entries of the slot's block
+        table.  Returns (first sampled token (int), new pools) —
+        `pools` was donated; callers must use the returned tree."""
+        compiled = self._compile_cb("prefill")
+        tok0, pools = compiled(params, pools,
+                               jnp.asarray(tokens, jnp.int32),
+                               jnp.int32(plen),
+                               jnp.asarray(row, jnp.int32),
+                               self._next_key())
+        return int(tok0), pools
+
+    def run_cb_decode(self, params, pools, tokens: np.ndarray,
+                      ntoks: np.ndarray, tables: np.ndarray):
+        """One decode step for all S slots.  Returns ((S,) int32 next
+        tokens on host, new pools).  `pools` was donated."""
+        compiled = self._compile_cb("decode")
+        nxt, pools = compiled(params, pools,
+                              jnp.asarray(tokens, jnp.int32),
+                              jnp.asarray(ntoks, jnp.int32),
+                              jnp.asarray(tables, jnp.int32),
+                              self._next_key())
+        return np.asarray(nxt), pools
+
     def _compile(self, mode: str, batch: int, prompt_len: int):
         key = (mode, batch, prompt_len)
         got = self._compiled.get(key)
@@ -481,6 +665,13 @@ class InferenceEngine:
         serving never compiles again (stats.compiles stays put)."""
         before = self.stats.compiles
         for mode in modes:
+            if mode == "generate" and self.spec.cb_on:
+                # cb replaces the generate buckets with exactly two
+                # programs — prefill + decode step — whatever the
+                # bucket list says; predict stays on buckets
+                self._compile_cb("prefill")
+                self._compile_cb("decode")
+                continue
             for b, p in self.spec.buckets:
                 self._compile(mode, b, p)
         return self.stats.compiles - before

@@ -511,6 +511,14 @@ class EngineFleet:
     def generate(self, tokens, timeout=None) -> Dict[str, Any]:
         return self.router.route("generate", tokens, timeout=timeout)
 
+    def generate_stream(self, tokens, timeout=None, max_new=None):
+        """Streaming generate through the fleet (cb members only):
+        yields {"token": t} events then the {"done": True, ...}
+        summary; retries on another engine only before the first
+        event (Router.route_stream)."""
+        return self.router.route_stream(tokens, timeout=timeout,
+                                        max_new=max_new)
+
     def predict(self, tokens, timeout=None) -> Dict[str, Any]:
         return self.router.route("predict", tokens, timeout=timeout)
 
@@ -595,6 +603,37 @@ class FleetServer:
                     self._reply(404,
                                 {"error": f"no route {self.path}"})
 
+            def _chunk(self, data):
+                self.wfile.write(f"{len(data):X}\r\n".encode()
+                                 + data + b"\r\n")
+
+            def _stream(self, tokens, req):
+                """Chunked passthrough: re-serialize the engine's
+                token events as they arrive — the full body is never
+                buffered at the fleet tier.  route_stream raises
+                BEFORE the 200 when no engine admits the stream, so
+                admission errors keep their status codes; a
+                mid-stream failure becomes a terminal {"error": ...}
+                line."""
+                mn = req.get("max_new")
+                stream = fleet.router.route_stream(
+                    tokens, timeout=req.get("timeout"),
+                    max_new=None if mn is None else int(mn))
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    for ev in stream:
+                        self._chunk(json.dumps(ev).encode() + b"\n")
+                except Exception as e:  # noqa: BLE001 — mid-stream
+                    self._chunk(json.dumps(
+                        {"error":
+                         f"{type(e).__name__}: {e}"}).encode()
+                        + b"\n")
+                self._chunk(b"")
+
             def do_POST(self):
                 mode = self.path.lstrip("/")
                 if mode not in ("generate", "predict"):
@@ -605,6 +644,9 @@ class FleetServer:
                     n = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(n) or b"{}")
                     tokens = np.asarray(req["tokens"], np.int32)
+                    if mode == "generate" and req.get("stream"):
+                        self._stream(tokens, req)
+                        return
                     out = fleet.router.route(mode, tokens,
                                              timeout=req.get(
                                                  "timeout"))

@@ -163,6 +163,37 @@ class LocalEngineHandle:
             raise EngineUnavailable(
                 f"engine {self.name} failed: {e}") from e
 
+    def request_stream(self, tokens, timeout: Optional[float] = None,
+                       max_new: Optional[int] = None):
+        """Streaming generate (cb engines only).  Admission happens
+        HERE, before any event is yielded — the router's commit point
+        for retry-on-other-engine.  Returns an iterator of ndjson-
+        shaped dicts: {"token": t} per token, then the final
+        {"done": True, ...} summary."""
+        if not self._alive:
+            raise EngineUnavailable(f"engine {self.name} is down")
+        try:
+            ticket = self.server.generate_stream(tokens,
+                                                 timeout=timeout,
+                                                 max_new=max_new)
+        except (Overloaded, DeadlineExpired, TimeoutError, ValueError):
+            raise
+        except Exception as e:  # noqa: BLE001 — no cb / stopped
+            raise EngineUnavailable(
+                f"engine {self.name} cannot stream: {e}") from e
+        budget = (timeout if timeout and timeout > 0
+                  else self.engine.spec.request_timeout_s) + 30.0
+
+        def gen():
+            for kind, payload in ticket.events(timeout=budget):
+                if kind == "tok":
+                    yield {"token": payload}
+                else:
+                    out = dict(payload)
+                    out["done"] = True
+                    yield out
+        return gen()
+
     def reload(self, step: Optional[int] = None) -> Dict[str, Any]:
         if not self._alive:
             raise EngineUnavailable(f"engine {self.name} is down")
@@ -237,6 +268,69 @@ class HttpEngineHandle:
             payload["timeout"] = timeout
         budget = (timeout or self.connect_timeout_s) + 30.0
         return self._call("POST", f"/{mode}", payload, timeout=budget)
+
+    def request_stream(self, tokens, timeout: Optional[float] = None,
+                       max_new: Optional[int] = None):
+        """Streaming generate over HTTP: POST {"stream": true} and
+        decode the chunked ndjson line-by-line WITHOUT buffering the
+        body.  The response status is the commit point: admission
+        errors surface as mapped exceptions before any line is
+        yielded; after that a transport failure is a mid-stream
+        RuntimeError (not retriable — tokens already flowed)."""
+        toks = (tokens.tolist() if isinstance(tokens, np.ndarray)
+                else list(tokens))
+        payload: Dict[str, Any] = {"tokens": [int(t) for t in toks],
+                                   "stream": True}
+        if timeout is not None:
+            payload["timeout"] = timeout
+        if max_new is not None:
+            payload["max_new"] = int(max_new)
+        budget = (timeout or self.connect_timeout_s) + 30.0
+        req = urllib.request.Request(
+            f"{self.base_url}/generate",
+            data=json.dumps(payload).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            resp = urllib.request.urlopen(req, timeout=budget)
+        except urllib.error.HTTPError as e:
+            body = {}
+            try:
+                body = json.loads(e.read())
+            except Exception:  # noqa: BLE001 — non-JSON error body
+                pass
+            if e.code == 503:
+                raise Overloaded(
+                    body.get("error", "overloaded"),
+                    retry_after=float(body.get("retry_after", 0.0)))
+            if e.code == 504:
+                raise DeadlineExpired(body.get("error", "deadline"))
+            if e.code == 400:
+                raise ValueError(body.get("error", "bad request"))
+            raise EngineUnavailable(
+                f"engine {self.name}: HTTP {e.code} "
+                f"{body.get('error', '')}")
+        except (urllib.error.URLError, ConnectionError, OSError) as e:
+            raise EngineUnavailable(
+                f"engine {self.name} unreachable: {e}") from e
+
+        def gen():
+            try:
+                with resp:
+                    for line in resp:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        ev = json.loads(line)
+                        if "error" in ev and "done" not in ev:
+                            raise RuntimeError(
+                                f"engine {self.name} stream failed: "
+                                f"{ev['error']}")
+                        yield ev
+            except (urllib.error.URLError, ConnectionError,
+                    OSError) as e:
+                raise RuntimeError(
+                    f"engine {self.name} stream broken: {e}") from e
+        return gen()
 
     def reload(self, step: Optional[int] = None) -> Dict[str, Any]:
         return self._call("POST", "/admin/reload", {"step": step},
@@ -537,6 +631,84 @@ class Router:
                    if not tried else
                    f"all {len(tried)} reachable engine(s) failed")
             self._shed(why)
+
+    def route_stream(self, tokens, timeout: Optional[float] = None,
+                     max_new: Optional[int] = None):
+        """Streaming dispatch: pick an engine exactly like `route`,
+        but return its token-event iterator instead of a buffered
+        result.  Retry-on-other-engine applies ONLY until the chosen
+        engine admits the stream (its `request_stream` returning is
+        the first-byte commit) — after that a failure surfaces to the
+        caller, because tokens may already be on the wire and a
+        replay would duplicate them.  The engine's in-flight slot is
+        held until the consumer exhausts (or abandons) the stream."""
+        if timeout is None:
+            timeout = self.spec.request_timeout_s
+        t0 = time.monotonic()
+        self.stats.count("routed")
+        budget = (self.spec.max_attempts
+                  if self.spec.max_attempts > 0 else len(self._members))
+        tried: set = set()
+        saturated = 0
+        for _attempt in range(budget):
+            name = self._pick(tried)
+            if name is None:
+                break
+            tried.add(name)
+            m = self._members[name]
+            try:
+                faults.maybe_fault("fleet.dispatch")
+                stream = m.handle.request_stream(tokens,
+                                                 timeout=timeout,
+                                                 max_new=max_new)
+            except Overloaded:
+                self._release(name)
+                saturated += 1
+                self.stats.count("retried")
+                continue
+            except (DeadlineExpired, TimeoutError, ValueError):
+                self._release(name)
+                self.stats.count("failed")
+                raise
+            except Exception as e:  # noqa: BLE001 — engine failure
+                self._release(name)
+                with self._lock:
+                    m.failed += 1
+                self._strike(name, f"stream dispatch failed: {e}")
+                self.stats.count("retried")
+                continue
+            # committed to this engine: wrap the stream so the
+            # in-flight accounting survives however the consumer
+            # finishes (exhaustion, error, or abandonment)
+            return self._wrap_stream(name, stream, t0)
+        why = ("fleet saturated" if saturated
+               else "no healthy engine available"
+               if not tried else
+               f"all {len(tried)} reachable engine(s) failed")
+        self._shed(why)
+
+    def _wrap_stream(self, name: str, stream, t0: float):
+        m = self._members[name]
+
+        def gen():
+            finished = False
+            try:
+                for ev in stream:
+                    if ev.get("done"):
+                        ev.setdefault("engine", name)
+                        finished = True
+                    yield ev
+            finally:
+                self._release(name)
+                if finished:
+                    with self._lock:
+                        m.dispatched += 1
+                        self._sheds_in_a_row = 0
+                    self.stats.count("completed")
+                    self.stats.observe_latency(time.monotonic() - t0)
+                else:
+                    self.stats.count("failed")
+        return gen()
 
     def _shed(self, why: str) -> None:
         with self._lock:

@@ -1,0 +1,454 @@
+"""Continuous-batching scheduler: per-slot admission into a running
+decode batch over the paged KV cache.
+
+The static MicroBatcher ties a request's fate to its batch: the
+compiled bucket program decodes all `max_new_tokens` for every row,
+so one long generation holds every co-batched short request hostage
+(BENCH_pr5's p50 7.6 ms vs p95 108.8 ms is exactly that head-of-line
+gap).  Here a request occupies one of `cb_slots` SLOTS instead:
+
+  admit    a free slot at ANY decode step — reserve its worst-case
+           blocks (ceil((plen + max_new) / block_len), so pool
+           exhaustion is an admission decision, never a mid-decode
+           OOM), run the ONE compiled prefill program into them, and
+           join the running batch on the next step;
+  step     the ONE compiled fixed-slot-count decode program advances
+           every active slot a token; inactive slots ride along
+           pointing at the null block (garbage out, masked, ignored);
+  retire   on EOS / max-new / deadline the slot's blocks return to
+           the free pool immediately and the slot is free for the
+           next admission that very step.
+
+Control plane vs data plane ("RPC Considered Harmful"): everything in
+this file is host-side numpy bookkeeping; device work is exactly one
+compiled-program invocation per prefill and one per decode step, both
+AOT-compiled at warmup with (slots, blocks-per-slot, block_len, pool
+size) as the only geometry — zero recompiles after warmup, same
+guarantee as the bucket path.
+
+Params atomicity: the loop reads `engine.params` ONCE per iteration
+and threads it through that iteration's prefills and decode step, so
+a hot-reload swap can never tear a step.  A stream that spans a
+reload finishes on the new params from the next step on — each step
+is internally consistent, which is the no-tear guarantee the static
+path makes per batch.
+
+Admission is strict FIFO: when the queue head cannot get a slot or
+its blocks, nothing behind it jumps ahead (no starvation of long
+prompts).  Shedding (`Overloaded` + Backoff retry_after) happens only
+when the pending queue itself is full — the same story as the
+MicroBatcher, with the block pool as the second bounded resource.
+
+Fault sites: `serve.admit` (shed one submission), `serve.batch` (fail
+one decode step — its active requests fail, the loop and server stay
+up, `consecutive_batch_failures` moves toward the degraded verdict).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .. import obs
+from ..utils import faults
+from .batcher import DeadlineExpired, Overloaded
+from .engine import InferenceEngine
+from .kvcache import PagedKVCache
+from .stats import ServeStats
+
+
+class StreamTicket:
+    """One request's future, streaming edition: tokens are observable
+    as they are produced (`events()` / `tokens()`), and `wait()`
+    blocks for the final result dict exactly like `Ticket.wait`."""
+
+    def __init__(self, corr: Optional[str] = None):
+        self.corr = corr
+        self._q: "queue.Queue" = queue.Queue()
+        self._done = threading.Event()
+        self._result: Optional[Dict[str, Any]] = None
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    # -- producer side (scheduler thread) -----------------------------------
+    def _emit(self, token: int) -> None:
+        self._q.put(("tok", int(token)))
+
+    def _resolve(self, result: Dict[str, Any]) -> None:
+        self._result = result
+        self._done.set()
+        self._q.put(("done", result))
+
+    def _fail(self, exc: BaseException) -> None:
+        self._error = exc
+        self._done.set()
+        self._q.put(("err", exc))
+
+    # -- consumer side ------------------------------------------------------
+    def events(self, timeout: Optional[float] = None):
+        """Yield ("tok", int) per produced token, then one ("done",
+        result).  Raises the failure; raises TimeoutError when no
+        event arrives within `timeout` seconds."""
+        while True:
+            try:
+                kind, payload = self._q.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError("stream stalled") from None
+            if kind == "err":
+                raise payload
+            yield kind, payload
+            if kind == "done":
+                return
+
+    def tokens(self, timeout: Optional[float] = None):
+        """Yield produced token ids; returns at end-of-stream."""
+        for kind, payload in self.events(timeout=timeout):
+            if kind == "tok":
+                yield payload
+
+    def wait(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        if not self._done.wait(timeout):
+            raise TimeoutError("request still queued/running")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+@dataclass
+class _CBRequest:
+    tokens: np.ndarray            # (plen,) int32
+    plen: int
+    max_new: int
+    nblocks: int                  # conservative reservation
+    ticket: StreamTicket
+    t_submit: float
+    deadline: Optional[float]
+    corr: str
+    t_admit: float = 0.0
+    produced: List[int] = field(default_factory=list)
+
+
+class ContinuousScheduler:
+    """See module docstring.  One daemon loop thread; `submit` is
+    called from any number of frontend threads."""
+
+    def __init__(self, engine: InferenceEngine,
+                 stats: Optional[ServeStats] = None, log_fn=print,
+                 backoff: Optional[faults.Backoff] = None):
+        if not engine.spec.cb_on:
+            raise ValueError("ContinuousScheduler needs a cb=on "
+                             "ServeSpec")
+        self.engine = engine
+        self.spec = engine.spec
+        self.stats = stats if stats is not None else engine.stats
+        self.log = log_fn
+        self._backoff = backoff if backoff is not None else \
+            faults.Backoff(base=0.05, cap=2.0, seed=self.spec.seed)
+        self.kv: Optional[PagedKVCache] = None
+        self._pending: deque = deque()
+        self._cv = threading.Condition()
+        self._req_ids = itertools.count(1)
+        self._sheds_in_a_row = 0
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        # slot state (numpy, scheduler-thread-owned)
+        s = self.spec.cb_slots
+        self._active = np.zeros((s,), bool)
+        self._ntoks = np.zeros((s,), np.int32)
+        self._last = np.zeros((s,), np.int32)
+        self._slot_req: List[Optional[_CBRequest]] = [None] * s
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "ContinuousScheduler":
+        if self._thread is not None:
+            return self
+        if self.engine.params is None:
+            raise RuntimeError("engine has no params; call load()")
+        spec = self.spec
+        if spec.cb_pool_blocks - 1 < spec.cb_blocks_per_slot:
+            # a pool that cannot hold even one worst-case request
+            # would wedge every admission; refuse loudly at startup
+            raise ValueError(
+                f"cb_blocks={spec.cb_pool_blocks} cannot hold one "
+                f"worst-case request ({spec.cb_blocks_per_slot} "
+                f"blocks + null)")
+        if self.kv is None:
+            import jax
+            dtype = jax.tree_util.tree_leaves(self.engine.params)[0].dtype
+            self.kv = PagedKVCache(
+                self.engine.net, num_slots=spec.cb_slots,
+                max_blocks_per_slot=spec.cb_blocks_per_slot,
+                num_blocks=spec.cb_pool_blocks,
+                block_len=spec.cb_block_len, dtype=dtype)
+            self.stats.gauge("cb_slot_capacity", spec.cb_slots)
+            self.stats.gauge("cb_blocks_total", self.kv.usable_blocks)
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop,
+                                        name="serve-cb", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        with self._cv:
+            leftovers = list(self._pending)
+            self._pending.clear()
+            self.stats.gauge("queue_depth", 0)
+        for r in leftovers:
+            self.stats.count("failed")
+            r.ticket._fail(RuntimeError("server shutting down"))
+        for s, r in enumerate(self._slot_req):
+            if r is not None:
+                self._retire(s, "shutdown", self.engine.params_step)
+
+    # -- admission ----------------------------------------------------------
+    def submit(self, tokens, timeout: Optional[float] = None,
+               max_new: Optional[int] = None) -> StreamTicket:
+        """Admit one generate request.  `max_new` caps this request's
+        generation (clamped to spec.max_new_tokens).  Raises
+        ValueError for a never-servable prompt (fail fast, the HTTP
+        layer's 400), `Overloaded` when the pending queue is full."""
+        spec = self.spec
+        arr = np.asarray(tokens, np.int32).reshape(-1)
+        if arr.size < 1:
+            self.stats.count("rejected")
+            raise ValueError("empty prompt")
+        if arr.size > spec.cb_max_prompt_len:
+            self.stats.count("rejected")
+            raise ValueError(
+                f"prompt length {arr.size} exceeds the cb prompt cap "
+                f"({spec.cb_max_prompt_len}); not servable")
+        mn = int(max_new) if max_new is not None else \
+            int(spec.max_new_tokens)
+        if mn < 1:
+            self.stats.count("rejected")
+            raise ValueError(f"max_new must be >= 1, got {mn}")
+        mn = min(mn, int(spec.max_new_tokens))
+        nblocks = -(-(int(arr.size) + mn) // int(spec.cb_block_len))
+        if timeout is None:
+            timeout = spec.request_timeout_s
+        now = time.monotonic()
+        corr = f"cbreq-{next(self._req_ids)}"
+        req = _CBRequest(tokens=arr, plen=int(arr.size), max_new=mn,
+                         nblocks=nblocks, ticket=StreamTicket(corr),
+                         t_submit=now,
+                         deadline=(now + timeout) if timeout > 0
+                         else None, corr=corr)
+        with obs.span("scheduler.admit", corr=corr,
+                      plen=int(arr.size), max_new=mn):
+            try:
+                faults.maybe_fault("serve.admit")
+            except faults.FaultError as e:
+                self._shed(f"admission fault: {e}", corr=corr)
+            with self._cv:
+                if self._stop:
+                    raise RuntimeError("scheduler is stopped")
+                if len(self._pending) >= spec.queue_capacity:
+                    pass          # shed outside the happy path below
+                else:
+                    self._pending.append(req)
+                    self._sheds_in_a_row = 0
+                    self.stats.count("submitted")
+                    self.stats.gauge("queue_depth", len(self._pending))
+                    self._cv.notify()
+                    return req.ticket
+            self._shed(f"queue full ({spec.queue_capacity} requests)",
+                       corr=corr)
+
+    def _shed(self, why: str, corr: Optional[str] = None) -> None:
+        with self._cv:
+            self._sheds_in_a_row += 1
+            attempt = self._sheds_in_a_row
+        self.stats.count("shed")
+        retry = self._backoff.delay(attempt - 1)
+        obs.emit_event("serve.shed", why=why, corr=corr,
+                       retry_after=round(retry, 4))
+        raise Overloaded(f"request shed ({why}); retry after "
+                         f"{retry:.3f}s", retry_after=retry)
+
+    # -- the loop -----------------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while (not self._pending and not self._active.any()
+                       and not self._stop):
+                    self._cv.wait(0.05)
+                if self._stop:
+                    return
+            self._iterate()
+
+    def _iterate(self) -> None:
+        """One scheduler step: expire, admit, decode, account."""
+        # ONE params read covers this step's prefills AND decode — the
+        # per-step no-tear guarantee (see module docstring)
+        params = self.engine.params
+        step_no = self.engine.params_step
+        now = time.monotonic()
+        self._expire_pending(now)
+        try:
+            self._admit_pending(params, step_no)
+            if self._active.any():
+                self._decode_step(params, step_no)
+        except Exception as e:  # noqa: BLE001 — fail step, keep serving
+            self._fail_step(e)
+            return
+        if self.kv is not None:
+            self.stats.observe_cb_step(int(self._active.sum()),
+                                       self.kv.blocks_in_use)
+            self.stats.gauge("cb_blocks_in_use", self.kv.blocks_in_use)
+
+    def _expire_pending(self, now: float) -> None:
+        with self._cv:
+            keep: deque = deque()
+            expired: List[_CBRequest] = []
+            for r in self._pending:
+                if r.deadline is not None and now > r.deadline:
+                    expired.append(r)
+                else:
+                    keep.append(r)
+            self._pending = keep
+            self.stats.gauge("queue_depth", len(self._pending))
+        for r in expired:
+            self.stats.count("expired")
+            r.ticket._fail(DeadlineExpired(
+                f"deadline passed after {now - r.t_submit:.3f}s in "
+                f"queue"))
+
+    def _admit_pending(self, params, step_no: int) -> None:
+        """Admit the queue head while a slot AND its blocks are free
+        (strict FIFO — a stuck head blocks, it is not overtaken)."""
+        spec = self.spec
+        while True:
+            free = np.flatnonzero(~self._active)
+            with self._cv:
+                if not self._pending or free.size == 0:
+                    return
+                if not self.kv.can_admit(self._pending[0].nblocks):
+                    return
+                req = self._pending.popleft()
+                self.stats.gauge("queue_depth", len(self._pending))
+            slot = int(free[0])
+            req.t_admit = time.monotonic()
+            row = self.kv.alloc(slot, req.nblocks)
+            toks = np.zeros((1, spec.cb_prefill_len), np.int32)
+            toks[0, :req.plen] = req.tokens
+            try:
+                with obs.span("scheduler.prefill", corr=req.corr,
+                              slot=slot, plen=req.plen):
+                    tok0, self.kv.pools = self.engine.run_cb_prefill(
+                        params, self.kv.pools, toks, req.plen,
+                        row[:spec.cb_prefill_len // spec.cb_block_len])
+            except Exception as e:  # noqa: BLE001 — fail req, keep going
+                # the slot is not in _slot_req yet: clean it here so
+                # the blocks cannot leak, fail only this request
+                self.kv.free(slot)
+                self.stats.count("failed")
+                self.stats.observe_batch_failure()
+                self.log(f"warning: cb prefill failed "
+                         f"({type(e).__name__}: {e}); request "
+                         f"{req.corr} failed, server continues")
+                req.ticket._fail(RuntimeError(f"prefill failed: {e}"))
+                return
+            self._slot_req[slot] = req
+            self._active[slot] = True
+            self._ntoks[slot] = req.plen
+            self._last[slot] = tok0
+            req.produced.append(tok0)
+            req.ticket._emit(tok0)
+            self._maybe_retire(slot, tok0, step_no,
+                               time.monotonic())
+
+    def _decode_step(self, params, step_no: int) -> None:
+        faults.maybe_fault("serve.batch")
+        nxt, self.kv.pools = self.engine.run_cb_decode(
+            params, self.kv.pools, self._last, self._ntoks,
+            self.kv.table_array())
+        now = time.monotonic()
+        for slot in np.flatnonzero(self._active):
+            slot = int(slot)
+            self._ntoks[slot] += 1
+            tok = int(nxt[slot])
+            self._last[slot] = tok
+            req = self._slot_req[slot]
+            req.produced.append(tok)
+            req.ticket._emit(tok)
+            self._maybe_retire(slot, tok, step_no, now)
+
+    def _maybe_retire(self, slot: int, tok: int, step_no: int,
+                      now: float) -> None:
+        req = self._slot_req[slot]
+        eos = self.spec.eos_id
+        if eos is not None and tok == eos:
+            self._retire(slot, "eos", step_no)
+        elif len(req.produced) >= req.max_new:
+            self._retire(slot, "length", step_no)
+        elif req.deadline is not None and now > req.deadline:
+            self._retire(slot, "deadline", step_no)
+
+    def _retire(self, slot: int, finish: str, step_no: int) -> None:
+        req = self._slot_req[slot]
+        self.kv.free(slot)
+        self._active[slot] = False
+        self._ntoks[slot] = 0
+        self._last[slot] = 0
+        self._slot_req[slot] = None
+        now = time.monotonic()
+        if finish == "shutdown":
+            self.stats.count("failed")
+            req.ticket._fail(RuntimeError("server shutting down"))
+            return
+        self.stats.observe_latency(now - req.t_submit)
+        self.stats.observe_request(req.t_admit - req.t_submit,
+                                   now - req.t_admit,
+                                   len(req.produced))
+        obs.emit_event("serve.cb_retire", corr=req.corr,
+                       finish=finish, tokens=len(req.produced),
+                       slot=slot)
+        req.ticket._resolve({"tokens": list(req.produced),
+                             "step": step_no, "finish": finish,
+                             "slots": self.spec.cb_slots})
+
+    def _fail_step(self, e: BaseException) -> None:
+        """A compiled call raised: fail every in-flight request, free
+        everything, keep the loop alive (the batcher's degrade
+        story)."""
+        n = int(self._active.sum())
+        self.stats.count("failed", n)
+        self.stats.observe_batch_failure()
+        self.log(f"warning: cb decode step failed "
+                 f"({type(e).__name__}: {e}); {n} request(s) failed, "
+                 f"server continues")
+        err = (e if isinstance(e, faults.FaultError)
+               else RuntimeError(f"decode step failed: {e}"))
+        for slot in np.flatnonzero(self._active):
+            slot = int(slot)
+            req = self._slot_req[slot]
+            self.kv.free(slot)
+            self._active[slot] = False
+            self._ntoks[slot] = 0
+            self._last[slot] = 0
+            self._slot_req[slot] = None
+            req.ticket._fail(err)
+
+    # -- reads --------------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        out = {"pending": len(self._pending),
+               "active_slots": int(self._active.sum()),
+               "slots": self.spec.cb_slots}
+        if self.kv is not None:
+            out["kv"] = self.kv.snapshot()
+        return out

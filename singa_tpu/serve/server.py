@@ -3,16 +3,25 @@ API over the same engine + batcher.
 
 The HTTP layer is deliberately thin — the transport never touches the
 hot path ("RPC Considered Harmful"): a handler thread only parses
-JSON, calls `MicroBatcher.submit`, and parks on the request's
-`Ticket`; all device work happens on the single dispatch thread
-through compiled bucket programs.  In-process callers
+JSON, calls `MicroBatcher.submit` (or `ContinuousScheduler.submit`
+under `cb=on`), and parks on the request's `Ticket` (or drains its
+`StreamTicket`); all device work happens on the single dispatch
+thread through compiled programs.  In-process callers
 (`InferenceServer.generate` / `.predict`, used by tests and the bench
 smoke) take the same submit/wait path, so both frontends share one
 admission-control, batching, and stats story.
 
 Endpoints:
     POST /generate  {"tokens": [ints], "timeout": s?}   -> {"tokens",
-                    "step", "bucket", "latency_ms"}
+                    "step", "bucket", "latency_ms"}; under cb=on the
+                    result carries "finish"/"slots" instead of
+                    "bucket", and {"stream": true} switches the
+                    response to chunked ndjson — one {"token": t}
+                    line per decode step, then a terminal
+                    {"done": true, "tokens", "finish", "step",
+                    "latency_ms"} line (admission errors keep their
+                    status codes; mid-stream failures become a
+                    terminal {"error": ...} line)
     POST /predict   {"tokens": [ints], "timeout": s?}   -> {"logprobs",
                     "step", "bucket", "latency_ms"}
     GET  /stats     ServeStats.snapshot() incl. served params step
@@ -51,6 +60,7 @@ import numpy as np
 from ..obs.metrics import MetricsRegistry
 from .batcher import DeadlineExpired, MicroBatcher, Overloaded
 from .engine import InferenceEngine, ServeSpec  # noqa: F401 (re-export)
+from .scheduler import ContinuousScheduler, StreamTicket
 from .stats import ServeStats  # noqa: F401 (re-export: stats mold)
 
 
@@ -67,6 +77,11 @@ class InferenceServer:
         self.engine = engine
         self.stats = engine.stats
         self.batcher = MicroBatcher(engine, log_fn=log_fn)
+        # cb=on: generate leaves the static buckets for the
+        # continuous-batching scheduler (predict stays on the
+        # batcher's bucket path)
+        self.scheduler = (ContinuousScheduler(engine, log_fn=log_fn)
+                          if engine.spec.cb_on else None)
         self.log = log_fn
         # per-server registry (not process-global: parallel tests each
         # get their own) backing the /metrics Prometheus endpoint
@@ -91,10 +106,15 @@ class InferenceServer:
             # when nothing is restorable
             self.engine.load()
         n = self.engine.warmup(self._warmup_modes)
-        self.log(f"serve: warmed {n} program(s) for buckets "
-                 f"{self.engine.spec.buckets}, serving checkpoint "
-                 f"step {self.engine.params_step}")
+        shape = (f"cb slots={self.engine.spec.cb_slots} "
+                 f"blocks={self.engine.spec.cb_pool_blocks}"
+                 if self.engine.spec.cb_on
+                 else f"buckets {self.engine.spec.buckets}")
+        self.log(f"serve: warmed {n} program(s) for {shape}, serving "
+                 f"checkpoint step {self.engine.params_step}")
         self.batcher.start()
+        if self.scheduler is not None:
+            self.scheduler.start()
         self._poll_stop.clear()
         if not self.engine.pinned:
             # pinned (fleet-member) engines never self-reload — the
@@ -125,6 +145,8 @@ class InferenceServer:
         if self._poll_thread is not None:
             self._poll_thread.join(5.0)
             self._poll_thread = None
+        if self.scheduler is not None:
+            self.scheduler.stop()
         self.batcher.stop()
 
     def __enter__(self) -> "InferenceServer":
@@ -145,17 +167,40 @@ class InferenceServer:
             self.engine.poll_reload()
 
     # -- in-process client API ---------------------------------------------
-    def generate(self, tokens,
-                 timeout: Optional[float] = None) -> Dict[str, Any]:
+    def generate(self, tokens, timeout: Optional[float] = None,
+                 max_new: Optional[int] = None) -> Dict[str, Any]:
         """Submit one prompt and block for the decoded continuation.
         Raises Overloaded / DeadlineExpired / TimeoutError exactly as
-        the HTTP layer maps them."""
+        the HTTP layer maps them.  `max_new` caps this request's
+        generation under cb; the static bucket path decodes the full
+        spec.max_new_tokens regardless (the whole batch shares one
+        compiled program) and only trims the reply."""
         t0 = time.monotonic()
-        ticket = self.batcher.submit(tokens, mode="generate",
-                                     timeout=timeout)
+        if self.scheduler is not None:
+            ticket = self.scheduler.submit(tokens, timeout=timeout,
+                                           max_new=max_new)
+        else:
+            ticket = self.batcher.submit(tokens, mode="generate",
+                                         timeout=timeout)
         out = ticket.wait(self._wait_budget(timeout))
+        if self.scheduler is None and max_new is not None \
+                and int(max_new) >= 1:
+            out["tokens"] = out["tokens"][:int(max_new)]
         out["latency_ms"] = round((time.monotonic() - t0) * 1e3, 3)
         return out
+
+    def generate_stream(self, tokens,
+                        timeout: Optional[float] = None,
+                        max_new: Optional[int] = None) -> StreamTicket:
+        """Streaming admission (cb only): returns the request's
+        `StreamTicket` — iterate `.tokens()` / `.events()` for tokens
+        as slots produce them.  Raises RuntimeError when the server
+        is not running continuous batching."""
+        if self.scheduler is None:
+            raise RuntimeError("streaming generate needs cb=on in the "
+                               "serve spec")
+        return self.scheduler.submit(tokens, timeout=timeout,
+                                     max_new=max_new)
 
     def predict(self, tokens,
                 timeout: Optional[float] = None) -> Dict[str, Any]:
@@ -178,6 +223,8 @@ class InferenceServer:
     def snapshot(self) -> Dict[str, Any]:
         out = self.stats.snapshot()
         out["params_step"] = self.engine.params_step
+        if self.scheduler is not None:
+            out["cb"] = self.scheduler.snapshot()
         return out
 
 
@@ -243,9 +290,19 @@ def _make_handler(server: InferenceServer):
                 req = json.loads(self.rfile.read(n) or b"{}")
                 tokens = np.asarray(req["tokens"], np.int32)
                 timeout = req.get("timeout")
-                call = (server.generate if mode == "generate"
-                        else server.predict)
-                self._reply(200, call(tokens, timeout=timeout))
+                if mode == "generate":
+                    max_new = req.get("max_new")
+                    if max_new is not None:
+                        max_new = int(max_new)
+                    if req.get("stream") and \
+                            server.scheduler is not None:
+                        self._stream_generate(tokens, timeout, max_new)
+                        return
+                    out = server.generate(tokens, timeout=timeout,
+                                          max_new=max_new)
+                else:
+                    out = server.predict(tokens, timeout=timeout)
+                self._reply(200, out)
             except Overloaded as e:
                 self._reply(503, {"error": str(e),
                                   "retry_after": e.retry_after},
@@ -256,5 +313,40 @@ def _make_handler(server: InferenceServer):
                 self._reply(400, {"error": f"bad request: {e}"})
             except Exception as e:  # noqa: BLE001 — failed batch etc.
                 self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+        def _chunk(self, data: bytes) -> None:
+            self.wfile.write(f"{len(data):X}\r\n".encode()
+                             + data + b"\r\n")
+
+        def _stream_generate(self, tokens, timeout, max_new) -> None:
+            """Chunked-transfer ndjson: one {"token": t} line per
+            produced token as the slot produces it, then a final
+            {"done": true, ...} summary line.  Admission errors raise
+            BEFORE any byte is sent and take the normal status-code
+            path in do_POST; a mid-stream failure becomes a terminal
+            {"error": ...} line (the 200 is already on the wire)."""
+            t0 = time.monotonic()
+            ticket = server.scheduler.submit(tokens, timeout=timeout,
+                                             max_new=max_new)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            try:
+                for kind, payload in ticket.events(
+                        timeout=server._wait_budget(timeout)):
+                    if kind == "tok":
+                        line = {"token": payload}
+                    else:
+                        line = dict(payload)
+                        line["done"] = True
+                        line["latency_ms"] = round(
+                            (time.monotonic() - t0) * 1e3, 3)
+                    self._chunk(json.dumps(line).encode() + b"\n")
+            except Exception as e:  # noqa: BLE001 — mid-stream failure
+                self._chunk(json.dumps(
+                    {"error": f"{type(e).__name__}: {e}"}).encode()
+                    + b"\n")
+            self._chunk(b"")      # terminal 0-length chunk
 
     return Handler

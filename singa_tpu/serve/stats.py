@@ -18,7 +18,15 @@ handler, the bench smoke, and tests all see the same semantics:
     `qps_recent`, completions within the last `qps_window_s` seconds,
     next to `uptime_s`);
   * `compiles` counts engine program compilations — a warmed server
-    must hold this constant (the zero-recompile acceptance gate).
+    must hold this constant (the zero-recompile acceptance gate);
+  * `observe_request` splits each completion's total latency into
+    queue-wait vs service time and records generated tokens + tok/s
+    (p50/p95 of each in `snapshot()`) — the attribution a bare
+    end-to-end percentile can't give;
+  * `observe_cb_step` feeds the continuous-batching occupancy pair:
+    `cb_slot_occupancy` (active slots / compiled slots, averaged over
+    scheduler steps) and `cb_block_utilization` (KV blocks in use /
+    pool size).
 
 `register_into(registry)` additionally exposes every snapshot field
 through an `obs.MetricsRegistry` pull-time collector (the /metrics
@@ -41,6 +49,14 @@ class ServeStats:
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
         self._latencies: deque = deque(maxlen=max(int(latency_window), 1))
+        # the total-latency split (observe_request): time in queue
+        # before dispatch/admission vs time being served, plus the
+        # per-request generated-token count and tok/s — the
+        # attribution BENCH_pr5's bare p50/p95 gap was missing
+        self._queue_waits: deque = deque(
+            maxlen=max(int(latency_window), 1))
+        self._services: deque = deque(maxlen=max(int(latency_window), 1))
+        self._tok_rates: deque = deque(maxlen=max(int(latency_window), 1))
         # completion timestamps for the windowed QPS (bounded: at most
         # latency_window recent completions contribute)
         self.qps_window_s = max(float(qps_window_s), 0.001)
@@ -52,7 +68,16 @@ class ServeStats:
         self.failed = 0          # engine/batch errors surfaced to requests
         self.expired = 0         # deadline passed before dispatch
         self.shed = 0            # admission rejected (queue full / fault)
+        self.rejected = 0        # never-servable request (fast 400)
         self.queue_depth = 0     # gauge: requests waiting right now
+        self.generated_tokens = 0
+        # continuous batching (serve/scheduler.py)
+        self.cb_steps = 0             # scheduler iterations run
+        self.cb_active_slot_steps = 0  # sum of active slots per step
+        self.cb_block_use_steps = 0    # sum of blocks in use per step
+        self.cb_slot_capacity = 0      # gauge: compiled slot count S
+        self.cb_blocks_total = 0       # gauge: usable pool blocks
+        self.cb_blocks_in_use = 0      # gauge: blocks held right now
         # batching
         self.batches = 0
         self.batched_requests = 0
@@ -97,6 +122,27 @@ class ServeStats:
             self._latencies.append(seconds)
             self._completions.append(time.monotonic())
 
+    def observe_request(self, queue_wait_s: float, service_s: float,
+                        ntokens: int) -> None:
+        """Attribute one completed request: time queued before
+        dispatch vs time being served, and its generated-token count
+        (tok/s recorded when both are positive).  Called next to
+        `observe_latency` by both the MicroBatcher and the
+        ContinuousScheduler."""
+        with self._lock:
+            self._queue_waits.append(max(queue_wait_s, 0.0))
+            self._services.append(max(service_s, 0.0))
+            self.generated_tokens += int(ntokens)
+            if ntokens > 0 and service_s > 0:
+                self._tok_rates.append(ntokens / service_s)
+
+    def observe_cb_step(self, active_slots: int,
+                        blocks_in_use: int) -> None:
+        with self._lock:
+            self.cb_steps += 1
+            self.cb_active_slot_steps += int(active_slots)
+            self.cb_block_use_steps += int(blocks_in_use)
+
     # -- reads -------------------------------------------------------------
     def latency_quantile(self, q: float) -> Optional[float]:
         """Seconds at quantile `q` over the recent-completion reservoir
@@ -107,6 +153,35 @@ class ServeStats:
             return None
         idx = min(int(q * len(lats)), len(lats) - 1)
         return lats[idx]
+
+    def split_quantile(self, kind: str, q: float) -> Optional[float]:
+        """Nearest-rank quantile over one of the observe_request
+        reservoirs: kind in ("queue_wait", "service",
+        "tokens_per_s")."""
+        src = {"queue_wait": self._queue_waits,
+               "service": self._services,
+               "tokens_per_s": self._tok_rates}[kind]
+        with self._lock:
+            vals = sorted(src)
+        if not vals:
+            return None
+        return vals[min(int(q * len(vals)), len(vals) - 1)]
+
+    def cb_slot_occupancy(self) -> Optional[float]:
+        """Active slots / compiled slots averaged over scheduler
+        steps (the cb sibling of `occupancy`)."""
+        with self._lock:
+            if self.cb_steps == 0 or self.cb_slot_capacity == 0:
+                return None
+            return self.cb_active_slot_steps / (
+                self.cb_steps * self.cb_slot_capacity)
+
+    def cb_block_utilization(self) -> Optional[float]:
+        with self._lock:
+            if self.cb_steps == 0 or self.cb_blocks_total == 0:
+                return None
+            return self.cb_block_use_steps / (
+                self.cb_steps * self.cb_blocks_total)
 
     def occupancy(self) -> Optional[float]:
         with self._lock:
@@ -144,12 +219,18 @@ class ServeStats:
         from ..obs.metrics import Sample
 
         counters = ("submitted", "completed", "failed", "expired",
-                    "shed", "batches", "batched_requests",
-                    "batch_slots", "compiles", "reloads",
-                    "reload_failures", "reloads_refused")
+                    "shed", "rejected", "generated_tokens", "batches",
+                    "batched_requests", "batch_slots", "cb_steps",
+                    "compiles", "reloads", "reload_failures",
+                    "reloads_refused")
         gauges = ("queue_depth", "consecutive_batch_failures", "qps",
                   "qps_recent", "uptime_s", "p50_latency_ms",
-                  "p95_latency_ms", "batch_occupancy")
+                  "p95_latency_ms", "p50_queue_wait_ms",
+                  "p95_queue_wait_ms", "p50_service_ms",
+                  "p95_service_ms", "p50_tokens_per_s",
+                  "p95_tokens_per_s", "batch_occupancy",
+                  "cb_slot_occupancy", "cb_block_utilization",
+                  "cb_blocks_in_use", "cb_blocks_total")
 
         def collect():
             snap = self.snapshot()
@@ -168,6 +249,8 @@ class ServeStats:
         p50, p95 = (self.latency_quantile(0.50),
                     self.latency_quantile(0.95))
         occ = self.occupancy()
+        cb_occ = self.cb_slot_occupancy()
+        cb_util = self.cb_block_utilization()
         with self._lock:
             out = {
                 "submitted": self.submitted,
@@ -175,10 +258,15 @@ class ServeStats:
                 "failed": self.failed,
                 "expired": self.expired,
                 "shed": self.shed,
+                "rejected": self.rejected,
                 "queue_depth": self.queue_depth,
+                "generated_tokens": self.generated_tokens,
                 "batches": self.batches,
                 "batched_requests": self.batched_requests,
                 "batch_slots": self.batch_slots,
+                "cb_steps": self.cb_steps,
+                "cb_blocks_in_use": self.cb_blocks_in_use,
+                "cb_blocks_total": self.cb_blocks_total,
                 "consecutive_batch_failures":
                     self.consecutive_batch_failures,
                 "compiles": self.compiles,
@@ -193,6 +281,20 @@ class ServeStats:
                                  if p50 is not None else None)
         out["p95_latency_ms"] = (round(p95 * 1e3, 3)
                                  if p95 is not None else None)
+        for kind, label in (("queue_wait", "queue_wait_ms"),
+                            ("service", "service_ms")):
+            for q, pre in ((0.50, "p50"), (0.95, "p95")):
+                v = self.split_quantile(kind, q)
+                out[f"{pre}_{label}"] = (round(v * 1e3, 3)
+                                         if v is not None else None)
+        for q, pre in ((0.50, "p50"), (0.95, "p95")):
+            v = self.split_quantile("tokens_per_s", q)
+            out[f"{pre}_tokens_per_s"] = (round(v, 3)
+                                          if v is not None else None)
         out["batch_occupancy"] = (round(occ, 4) if occ is not None
                                   else None)
+        out["cb_slot_occupancy"] = (round(cb_occ, 4)
+                                    if cb_occ is not None else None)
+        out["cb_block_utilization"] = (round(cb_util, 4)
+                                       if cb_util is not None else None)
         return out
