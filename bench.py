@@ -702,6 +702,210 @@ def bench_fleet_smoke(n_clients=6, reqs_per_client=6, out=None):
     return result
 
 
+def bench_pipeline_smoke(out=None):
+    """ISSUE 10 acceptance: the closed train-and-serve loop on CPU,
+    twice over one tiny LM — the run FAILS (raises) unless:
+
+    Clean phase: a throttled supervised trainer publishes 4 blessed
+    checkpoints (steps 6/12/18/24); EVERY one of them is canaried and
+    promoted, in order, with zero rollbacks, and the blessed-to-served
+    lag stays single-digit seconds.
+
+    Faulted phase (fresh workspace): under seeded injection — a
+    trainer preemption (kill), a torn checkpoint save (corrupt), and a
+    NaN'd gradient window (diverge) — zero client requests fail, no
+    response ever comes from below the promoted step or from a
+    non-blessed step, the torn save is refused at the canary, and the
+    loop still drains (served == last blessed) by the end.
+
+    Records both phases' counters; `out` writes the JSON line to a
+    file as well (scripts/pipeline_smoke.sh -> BENCH_pr10.json)."""
+    import tempfile
+    import threading
+
+    import jax
+
+    from singa_tpu.core.pipeline import PipelineController, PipelineSpec
+    from singa_tpu.core.supervisor import Supervisor
+    from singa_tpu.core.trainer import Trainer
+    from singa_tpu.models.transformer import (synthetic_token_batches,
+                                              transformer_lm)
+    from singa_tpu.serve import EngineFleet, RolloutSpec, ServeSpec
+    from singa_tpu.utils.faults import FaultSchedule, inject
+    from singa_tpu.utils.health import HealthMonitor, HealthSpec
+
+    vocab, seq = 64, 16
+    shapes = {"data": {"input": (seq,), "target": (seq,)}}
+    blessed_cadence = (6, 12, 18, 24)
+
+    def run_loop(schedule):
+        """One closed-loop run; returns (controller, supervisor,
+        fleet, responses[(pinned_before, step)], failures,
+        pinned_transitions)."""
+        cfg = transformer_lm(vocab_size=vocab, num_layers=2,
+                             embed_dim=32, num_heads=4, head_dim=8,
+                             seq_len=seq, batchsize=4, train_steps=24)
+        cfg.checkpoint_frequency = 6
+        ws = tempfile.mkdtemp(prefix="pipeline_smoke_")
+        tr = Trainer(cfg, shapes, log_fn=lambda s: None, donate=False,
+                     health=HealthMonitor(HealthSpec(),
+                                          log_fn=lambda s: None))
+        sup = Supervisor(tr, ws, max_restarts=3, log=lambda s: None)
+        net = tr.test_net or tr.train_net
+        fleet = EngineFleet.local(
+            net, ServeSpec(buckets=((2, 8),), max_new_tokens=4,
+                           batch_window_s=0.002),
+            2, workspace=ws,
+            params=net.init_params(jax.random.PRNGKey(0)),
+            rollout_spec=RolloutSpec(poll_s=0.05, window_s=0.2,
+                                     min_requests=1),
+            log_fn=lambda s: None)
+        ctl = PipelineController(sup, fleet, ws,
+                                 spec=PipelineSpec(lag_alarm_s=30),
+                                 log_fn=lambda s: None)
+        # pace training (~0.2 s/step) so the rollout can promote every
+        # cadence save before the next one lands — the clean phase
+        # gates on promote-per-publish, not newest-wins catch-up
+        throttle = [lambda s, m: time.sleep(0.2)]
+        rng = np.random.default_rng(0)
+        responses, transitions, failures = [], [], [0]
+        with inject(schedule):
+            ctl.start(lambda: synthetic_token_batches(4, seq, vocab,
+                                                      seed=5),
+                      seed=0, hooks=throttle)
+            try:
+                deadline = time.monotonic() + 300.0
+                while time.monotonic() < deadline:
+                    done = not ctl.train_running()
+                    lag = ctl.lag()
+                    pinned = fleet.rollout.pinned_step
+                    if not transitions or transitions[-1] != pinned:
+                        transitions.append(pinned)
+                    plen = int(rng.integers(1, 7))
+                    prompt = rng.integers(1, vocab,
+                                          plen).astype(np.int32)
+                    try:
+                        got = ctl.generate(prompt)
+                        responses.append((pinned, got["step"]))
+                    except Exception:  # noqa: BLE001 — gated below
+                        failures[0] += 1
+                    if done and lag["lag_steps"] == 0 and \
+                            lag["blessed_step"] >= 0:
+                        break
+                if not ctl.wait(timeout=60.0):
+                    raise RuntimeError("pipeline training never "
+                                       "finished")
+            finally:
+                ctl.stop()
+        return ctl, sup, fleet, responses, failures[0], transitions
+
+    failures = []
+
+    # -- clean phase: every blessed checkpoint promotes, in order -----
+    ctl, sup, fleet, responses, client_failures, transitions = \
+        run_loop(None)
+    clean_lag = ctl.lag()
+    promoted = [p for p in transitions if p >= 0]
+    if ctl.train_error is not None or sup.failures:
+        failures.append(f"clean run not clean: {ctl.train_error!r}, "
+                        f"{sup.failures}")
+    if client_failures:
+        failures.append(f"clean run client failures: "
+                        f"{client_failures}")
+    if promoted != list(blessed_cadence):
+        failures.append(f"clean run did not promote every blessed "
+                        f"checkpoint in order: {promoted} != "
+                        f"{list(blessed_cadence)}")
+    if fleet.rollout.rollbacks != 0:
+        failures.append(f"clean run rolled back "
+                        f"{fleet.rollout.rollbacks}x")
+    clean_promote_lag = (max(ctl.promote_lags_s)
+                         if ctl.promote_lags_s else None)
+    if clean_promote_lag is None or clean_promote_lag >= 10.0:
+        failures.append(f"blessed-to-served lag not single-digit "
+                        f"seconds: {clean_promote_lag}")
+    clean = {
+        "published": ctl.published,
+        "promotions": fleet.rollout.promotions,
+        "rollbacks": fleet.rollout.rollbacks,
+        "canary_restarts": fleet.rollout.canary_restarts,
+        "promoted_sequence": promoted,
+        "promote_lag_max_s": (round(clean_promote_lag, 3)
+                              if clean_promote_lag else None),
+        "requests": len(responses),
+        "client_failures": client_failures,
+        "served_step": clean_lag["served_step"],
+    }
+
+    # -- faulted phase: kill + corrupt + diverge, traffic never blinks
+    sched = FaultSchedule.parse(
+        "step.train@8:preempt,ckpt.save@2:torn,step.grad@14:nan",
+        seed=0)
+    ctl, sup, fleet, responses, client_failures, transitions = \
+        run_loop(sched)
+    fault_lag = ctl.lag()
+    blessed_ok = set(blessed_cadence) | {-1}
+    below_pinned = [(p, s) for p, s in responses if s < p]
+    off_blessed = sorted({s for _, s in responses}) if any(
+        s not in blessed_ok for _, s in responses) else []
+    if client_failures:
+        failures.append(f"faulted run client failures: "
+                        f"{client_failures}")
+    if ctl.train_error is not None:
+        failures.append(f"faulted run training failed: "
+                        f"{ctl.train_error!r}")
+    kinds = sorted(f.kind for f in sup.failures)
+    if kinds != ["divergence", "preemption"]:
+        failures.append(f"expected one preemption + one divergence "
+                        f"rescue, got {kinds}")
+    if {f.site for f in sched.fired} != \
+            {"step.train", "ckpt.save", "step.grad"}:
+        failures.append(f"injected faults did not all fire: "
+                        f"{sched.fired}")
+    if below_pinned:
+        failures.append(f"responses served from below the promoted "
+                        f"step: {below_pinned[:5]}")
+    if off_blessed:
+        failures.append(f"responses served from non-blessed steps: "
+                        f"{off_blessed}")
+    if fleet.rollout.refusals < 1:
+        failures.append("torn checkpoint was never refused at the "
+                        "canary")
+    if fault_lag["lag_steps"] != 0 or \
+            fault_lag["served_step"] != blessed_cadence[-1]:
+        failures.append(f"faulted loop did not drain: {fault_lag}")
+    faulted = {
+        "published": ctl.published,
+        "promotions": fleet.rollout.promotions,
+        "rollbacks": fleet.rollout.rollbacks,
+        "refusals": fleet.rollout.refusals,
+        "torn_polls": fleet.rollout.mgr.torn_polls,
+        "supervisor_failures": kinds,
+        "requests": len(responses),
+        "client_failures": client_failures,
+        "served_step": fault_lag["served_step"],
+        "blessed_step": fault_lag["blessed_step"],
+    }
+
+    if failures:
+        raise RuntimeError("pipeline smoke FAILED: "
+                           + "; ".join(failures))
+
+    result = {
+        "metric": "pipeline_smoke_promote_lag",
+        "value": clean["promote_lag_max_s"],
+        "unit": "s",
+        "clean": clean,
+        "faulted": faulted,
+        "backend": jax.default_backend(),
+    }
+    line = json.dumps(result)
+    if out:
+        with open(out, "w") as f:
+            f.write(line + "\n")
+    return result
+
+
 def bench_cb_smoke(n_requests=64, n_long=3, out=None):
     """ISSUE 8 acceptance: continuous batching vs the static bucket
     path under the same mixed load, over real HTTP.  61 shorts
@@ -1039,6 +1243,12 @@ def main() -> None:
         if "--out" in sys.argv:
             out = sys.argv[sys.argv.index("--out") + 1]
         print(json.dumps(bench_fleet_smoke(out=out)))
+        return
+    if "--pipeline-smoke" in sys.argv:
+        out = None
+        if "--out" in sys.argv:
+            out = sys.argv[sys.argv.index("--out") + 1]
+        print(json.dumps(bench_pipeline_smoke(out=out)))
         return
     if "--cb-smoke" in sys.argv:
         out = None

@@ -207,3 +207,17 @@ fi
 grep -q "training done" "$CLEAN_LOG" || {
     cat "$CLEAN_LOG"; echo "CLEAN HEALTH RUN DID NOT FINISH"; exit 1; }
 echo "CLEAN HEALTH RUN PASS: zero poisoned/divergence flags"
+
+# Pipeline leg (ISSUE 10): the closed train-and-serve loop under the
+# same injected preemption — the supervisor absorbs the kill while the
+# fleet keeps serving; the subcommand exits non-zero unless the loop
+# drained (every blessed checkpoint promoted, zero failed requests).
+PWS=$(mktemp -d -t fault_smoke_pipeline_XXXX)
+trap 'rm -rf "$WS" "$CLEAN_LOG" "$PWS"' EXIT
+python -m singa_tpu.main pipeline \
+    -model_conf examples/transformer/lm_tiny.conf \
+    --workspace "$PWS" --synthetic --smoke 20 \
+    --fault_spec "step.train@20:preempt" \
+    | grep -E '"lag_steps": 0' > /dev/null || {
+        echo "FAULT SMOKE PIPELINE LEG FAILED"; exit 1; }
+echo "FAULT SMOKE PIPELINE PASS: preempted trainer invisible to traffic"

@@ -6,3 +6,4 @@ from .layers import (Layer, LayerError, ParamSpec, Context, create_layer,
 from .net import NeuralNet, build_net
 from .trainer import Trainer, Performance, TimerInfo
 from .supervisor import Supervisor, TrainingAborted, FailureRecord
+from .pipeline import PipelineController, PipelineSpec

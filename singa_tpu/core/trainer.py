@@ -182,6 +182,15 @@ class Trainer:
         self._build_steps(donate)
         self.perf = Performance()
         self.timer = TimerInfo()
+        # post-save publication hook (step, verdict) — the closed-loop
+        # pipeline's train→serve seam (core/pipeline.py wires it).
+        # Fires AFTER a snapshot is durably on disk with its health
+        # verdict recorded; the cadence path drains the metrics ring
+        # before every save, so drain-before-publish holds for free.
+        # Observer semantics: a raising hook is logged, never a step
+        # failure.
+        self.on_checkpoint: Optional[Callable[[int, Optional[str]],
+                                              None]] = None
         for nm, freq, steps in (
                 ("test", model_cfg.test_frequency, model_cfg.test_steps),
                 ("validation", model_cfg.validation_frequency,
@@ -983,6 +992,7 @@ class Trainer:
             return False
         if self.health is None:
             ckpt.save(step, *self._ckpt_state(params, opt_state))
+            self._publish(step, None)
             return True
         if not self.health.ok_to_save():
             rec = self.health.snapshot_health()
@@ -992,10 +1002,28 @@ class Trainer:
             obs.emit_event("ckpt.refused", step=step,
                            verdict=rec["verdict"])
             return False
+        rec = self.health.snapshot_health()
         ckpt.save(step, *self._ckpt_state(params, opt_state),
-                  health=self.health.snapshot_health())
+                  health=rec)
         self.health.mark_snapshot()
+        self._publish(step, rec.get("verdict"))
         return True
+
+    def _publish(self, step: int, verdict) -> None:
+        """Fire the post-save publication hook (`on_checkpoint`).
+        Runs after the snapshot (and its manifest verdict) is on disk
+        — the point where a serving tier may trust the step.  Hook
+        failures are logged observer-style, exactly like user hooks:
+        publication is telemetry for the loop, not training logic."""
+        hook = self.on_checkpoint
+        if hook is None:
+            return
+        try:
+            hook(step, verdict)
+        except Exception as e:  # noqa: BLE001 — observer, not logic
+            self.log(f"warning: checkpoint publish hook raised at "
+                     f"step {step} ({type(e).__name__}: {e}); "
+                     f"continuing")
 
     def apply_lr_backoff(self, factor: float) -> float:
         """Scale the effective learning rate by `factor` (the

@@ -22,7 +22,15 @@ the request body carries `"stream": true` (docs/SERVING.md).
 `serve --fleet N` runs N pinned engine workers behind a
 health-driven router with canary rollout/auto-rollback;
 `serve --fleet_hostfile h` adopts already-running `serve --pinned`
-processes as the fleet.  Both subcommands take `--obs on
+processes as the fleet.
+
+Closed-loop pipeline (docs/PIPELINE.md):
+    python -m singa_tpu.main pipeline -model_conf lm.conf \
+        --workspace ws --synthetic [--fleet 2] [--smoke 50]
+runs the supervised trainer AND the serving fleet concurrently against
+one workspace: every health-blessed checkpoint is canaried and
+promoted to traffic within bounded lag, and a DIVERGED step is never
+served by more than the canary.  All subcommands take `--obs on
 [--obs_spec ...]` for the unified telemetry layer
 (docs/OBSERVABILITY.md).
 """
@@ -372,10 +380,259 @@ def _serve_vocab(net) -> int:
     return 256
 
 
+def make_pipeline_argparser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="singa_tpu pipeline",
+        description="closed-loop train-and-serve (docs/PIPELINE.md): "
+                    "a supervised trainer and a serving fleet run "
+                    "concurrently against ONE workspace — every "
+                    "health-blessed checkpoint is canaried and "
+                    "promoted to traffic within bounded lag, and a "
+                    "DIVERGED step is never served by more than the "
+                    "canary")
+    ap.add_argument("-model_conf", "--model_conf", required=True)
+    ap.add_argument("--workspace", required=True,
+                    help="the shared checkpoint workspace — the "
+                         "trainer publishes into it, the fleet "
+                         "promotes out of it")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="override ModelProto.train_steps")
+    ap.add_argument("--batchsize", type=int, default=0,
+                    help="override every data layer's batchsize")
+    ap.add_argument("--synthetic", action="store_true",
+                    help="use a synthetic learnable dataset")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--resume", action="store_true",
+                    help="resume training from the workspace's latest "
+                         "healthy checkpoint")
+    ap.add_argument("--max-restarts", "--max_restarts", type=int,
+                    dest="max_restarts", default=3,
+                    help="trainer supervision budget (pipeline mode "
+                         "is always supervised; default 3)")
+    ap.add_argument("--scan_chunk", type=int, default=0)
+    ap.add_argument("--health", choices=("on", "off"), default="on",
+                    help="numeric-health sentinel on the trainer — "
+                         "checkpoint verdicts are what bless a step "
+                         "for promotion (docs/FAULT_TOLERANCE.md)")
+    ap.add_argument("--health_spec", default=None)
+    ap.add_argument("--fault_spec", default=None,
+                    help="deterministic fault injection across BOTH "
+                         "halves (train + serve sites, plus "
+                         "pipeline.publish; singa_tpu/utils/faults.py)")
+    ap.add_argument("--serve_spec", default=None,
+                    help="ServeSpec for the fleet's engines")
+    ap.add_argument("--fleet", type=int, default=2, metavar="N",
+                    help="serving fleet size (default 2: one canary, "
+                         "one stable)")
+    ap.add_argument("--fleet_spec", default=None,
+                    help="RouterSpec key=value entries")
+    ap.add_argument("--rollout_spec", default=None,
+                    help="RolloutSpec key=value entries (poll_s "
+                         "bounds the fingerprint-poll half of the "
+                         "blessed-to-served lag)")
+    ap.add_argument("--pipeline_spec", default=None,
+                    help="PipelineSpec key=value entries, e.g. "
+                         "'lag_alarm_s=10,join_s=600' "
+                         "(singa_tpu/core/pipeline.py)")
+    ap.add_argument("--smoke", type=int, default=0, metavar="N",
+                    help="drive >= N in-process client requests while "
+                         "training runs, wait for the loop to drain "
+                         "(blessed == served), print the pipeline "
+                         "snapshot as JSON, and exit (no HTTP)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8000,
+                    help="FleetServer HTTP port (0 = ephemeral)")
+    _add_obs_flags(ap)
+    return ap
+
+
+def pipeline_main(argv) -> int:
+    """The `pipeline` subcommand: trainer + fleet, one workspace, the
+    `PipelineController` owning the seam."""
+    import json as _json
+    import time as _time
+
+    args = make_pipeline_argparser().parse_args(argv)
+    from .utils.faults import FaultSchedule, inject
+    schedule = (FaultSchedule.parse(args.fault_spec, seed=args.seed)
+                if args.fault_spec else None)
+    log = obs.get_logger("pipeline")
+    obs_on = _obs_enable(args, args.workspace)
+    try:
+        model = load_model_config(args.model_conf)
+        if args.steps is not None:
+            model.train_steps = args.steps
+        from .data import discover_input_shapes, resolve_data_source
+        if args.batchsize:
+            for layer in (model.neuralnet.layer
+                          if model.neuralnet else []):
+                if layer.data_param:
+                    layer.data_param.batchsize = args.batchsize
+                if layer.seqdata_param:
+                    layer.seqdata_param.batchsize = args.batchsize
+        input_shapes = discover_input_shapes(
+            model, force_synthetic=args.synthetic)
+
+        from .utils.health import HealthMonitor, HealthSpec
+        health_spec = HealthSpec.parse(args.health_spec)
+        health = (HealthMonitor(health_spec,
+                                log_fn=obs.get_logger("health"))
+                  if args.health == "on" else None)
+        if health is None:
+            log("warning: --health off means every checkpoint "
+                "publishes unclassified — only the canary gate "
+                "stands between a diverged step and traffic")
+
+        trainer = Trainer(model, input_shapes, health=health)
+        reg = obs.registry()
+        if reg is not None:
+            trainer.timer.register_into(reg)
+            if health is not None:
+                health.register_into(reg)
+
+        from .core.pipeline import PipelineController, PipelineSpec
+        from .core.supervisor import Supervisor, TrainingAborted
+        sup = Supervisor(trainer, args.workspace,
+                         max_restarts=max(args.max_restarts, 1),
+                         max_divergences=health_spec.max_divergences,
+                         blame_batches=health_spec.blame_batches,
+                         lr_backoff=health_spec.lr_backoff,
+                         log=obs.get_logger("supervisor"))
+
+        train_layer = next(
+            (l for l in model.neuralnet.layer
+             if l.type in ("kShardData", "kLMDBData", "kSequenceData")
+             and "kTrain" not in l.exclude),
+            None)
+        if train_layer is None:
+            bs = 64
+        elif train_layer.type == "kSequenceData":
+            bs = (train_layer.seqdata_param.batchsize
+                  if train_layer.seqdata_param else 64)
+        else:
+            bs = train_layer.data_param.batchsize
+
+        def make_train_iter():
+            it, _ = resolve_data_source(
+                model, bs, seed=args.seed,
+                force_synthetic=args.synthetic,
+                sample_shapes=input_shapes)
+            return it
+
+        import jax
+
+        from .serve import (EngineFleet, FleetServer, RolloutSpec,
+                            RouterSpec, ServeSpec)
+        spec = (ServeSpec.parse(args.serve_spec) if args.serve_spec
+                else ServeSpec())
+        net = trainer.test_net or trainer.train_net
+        fallback = net.init_params(jax.random.PRNGKey(args.seed))
+        fleet = EngineFleet.local(
+            net, spec, args.fleet, workspace=args.workspace,
+            params=fallback, router_spec=RouterSpec.parse(args.fleet_spec),
+            rollout_spec=RolloutSpec.parse(args.rollout_spec),
+            log_fn=obs.get_logger("fleet"))
+        ctl = PipelineController(
+            sup, fleet, args.workspace,
+            spec=PipelineSpec.parse(args.pipeline_spec), log_fn=log)
+        if reg is not None:
+            fleet.router.stats.register_into(reg)
+            ctl.register_into(reg)
+
+        with inject(schedule):
+            if schedule is not None:
+                log(f"fault injection active: {args.fault_spec} "
+                    f"(seed {args.seed})")
+            ctl.start(make_train_iter, seed=args.seed,
+                      scan_chunk=args.scan_chunk, resume=args.resume)
+            try:
+                if args.smoke > 0:
+                    rc = _pipeline_smoke(ctl, net, args, log)
+                    print(_json.dumps(ctl.snapshot()))
+                    return rc
+                front = FleetServer(fleet, host=args.host,
+                                    port=args.port, log_fn=log)
+                ctl.register_into(front.metrics)
+                front.start()
+                try:
+                    while not ctl.wait(timeout=1.0):
+                        pass
+                    if isinstance(ctl.train_error, TrainingAborted):
+                        log(f"error: {ctl.train_error}")
+                    log("pipeline: training finished; fleet keeps "
+                        "serving (Ctrl-C to stop)")
+                    while True:
+                        _time.sleep(3600)
+                except KeyboardInterrupt:
+                    log("pipeline: shutting down")
+                    print(_json.dumps(ctl.snapshot()))
+                    return 0
+                finally:
+                    front.stop()
+            finally:
+                ctl.stop()
+    finally:
+        if obs_on:
+            obs.disable()
+
+
+def _pipeline_smoke(ctl, net, args, log) -> int:
+    """In-process client loop for `pipeline --smoke N`: keep requests
+    flowing while training runs, then wait for the loop to drain
+    (every blessed step promoted).  Exit 0 only when training
+    finished, no client request failed, and blessed == served."""
+    import time as _time
+
+    import numpy as np
+
+    rng = np.random.default_rng(args.seed)
+    vocab = _serve_vocab(net)
+    sent = failed = 0
+    drain_deadline = None
+    while True:
+        train_done = not ctl.train_running()
+        lag = ctl.lag()
+        if train_done and drain_deadline is None:
+            # bounded drain: give the rollout a few alarm windows to
+            # promote the tail, then report whatever lag remains
+            drain_deadline = _time.monotonic() + \
+                3 * float(ctl.spec.lag_alarm_s)
+        drained = lag["lag_steps"] == 0
+        if train_done and sent >= args.smoke and \
+                (drained or ctl.train_error is not None
+                 or _time.monotonic() >= drain_deadline):
+            break
+        plen = int(rng.integers(1, 9))
+        prompt = rng.integers(0, vocab, plen).astype("int32")
+        try:
+            out = ctl.generate(prompt)
+            sent += 1
+            if sent % 25 == 0 or sent == 1:
+                log(f"smoke {sent}: step {out['step']} on "
+                    f"{out['engine']} (blessed "
+                    f"{lag['blessed_step']}, served "
+                    f"{lag['served_step']})")
+        except Exception as e:  # noqa: BLE001 — a failure is the verdict
+            failed += 1
+            log(f"warning: smoke request failed "
+                f"({type(e).__name__}: {e})")
+            _time.sleep(0.05)
+    lag = ctl.lag()
+    ok = (ctl.train_error is None and failed == 0
+          and lag["lag_steps"] == 0)
+    log(f"pipeline smoke: {sent} requests ({failed} failed), "
+        f"blessed {lag['blessed_step']} served {lag['served_step']}"
+        + ("" if ctl.train_error is None
+           else f", training FAILED: {ctl.train_error!r}"))
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "serve":
         return serve_main(argv[1:])
+    if argv and argv[0] == "pipeline":
+        return pipeline_main(argv[1:])
     args = make_argparser().parse_args(argv)
     from .utils.faults import FaultSchedule, inject
     schedule = (FaultSchedule.parse(args.fault_spec, seed=args.seed)

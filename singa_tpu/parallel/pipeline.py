@@ -25,7 +25,15 @@ from typing import Any, Callable, Sequence
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.4.35 jax: experimental namespace, and the
+    # replication-check kwarg is still called check_rep there
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, **kw):
+        kw["check_rep"] = kw.pop("check_vma", True)
+        return _shard_map(f, **kw)
 
 
 def stack_stage_params(per_stage_params: Sequence[Any]) -> Any:

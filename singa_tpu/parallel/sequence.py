@@ -22,7 +22,15 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.4.35 jax: experimental namespace, and the
+    # replication-check kwarg is still called check_rep there
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, **kw):
+        kw["check_rep"] = kw.pop("check_vma", True)
+        return _shard_map(f, **kw)
 
 from ..ops.attention import (NEG_INF, attention_reference,
                              chunk_attention_blockwise, flash_chunk,

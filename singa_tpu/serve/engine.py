@@ -27,7 +27,12 @@ started with — a reload never drops a request.  Every degradation is
 a counted non-event: a failed restore keeps the old params live
 (`reload_failures`, fingerprint unchanged so the next poll retries);
 a walk-back that lands on the already-served step is `reloads_refused`
-(fingerprint recorded so it is not re-attempted every poll).
+(fingerprint recorded so it is not re-attempted every poll); a poll
+that races a LIVE writer (a step list or MANIFEST.json caught
+mid-rename/half-written) is `torn_polls` — surfaced as "no change",
+never an exception and never a reload off the torn read, so a trainer
+publishing into the served workspace is safe by construction
+(docs/PIPELINE.md).
 """
 
 from __future__ import annotations
@@ -248,6 +253,12 @@ class InferenceEngine:
                      if workspace is not None else None)
         self._params = (jax.device_put(params)
                         if params is not None else None)
+        # the fresh-init fallback, kept forever: `reload_to(step=-1)`
+        # restores it, so a fleet rollback works even when the pinned
+        # step is -1 (cold start — nothing was ever promoted, yet a
+        # canaried-then-rejected first checkpoint must still be
+        # unseated from the canary)
+        self._init_params = self._params
         self.params_step: int = -1
         self._fingerprint: Optional[tuple] = None
         # pinned-fingerprint mode (fleet members): the engine never
@@ -260,6 +271,15 @@ class InferenceEngine:
         # successful reload) so the router sees a degraded verdict
         # instead of an unconditional ok
         self._stale_reason: Optional[str] = None
+        # the params served immediately before the last EXPLICIT
+        # reload (the fleet rollout's command channel).  The pinned
+        # snapshot on disk can be GC'd (max_to_keep) while the fleet
+        # still serves it, so a canary rollback to the pinned step
+        # must be satisfiable from memory — one extra params copy per
+        # fleet engine is the price of an instant, disk-independent
+        # rollback.  Solo (polling) engines never populate it.
+        self._prev_params = None
+        self._prev_step: Optional[int] = None
         self._compiled: Dict[Tuple[str, int, int], Any] = {}
         self._compile_lock = threading.Lock()
         self._key_counter = 0
@@ -322,7 +342,16 @@ class InferenceEngine:
     def _poll_reload(self) -> str:
         try:
             faults.maybe_fault("serve.reload")
+            torn_before = self.ckpt.torn_polls
             fp = self.ckpt.fingerprint()
+            if self.ckpt.torn_polls > torn_before:
+                # the poll raced a live writer (mid-rename / partial
+                # MANIFEST.json): a counted non-event, NOT a failure —
+                # fingerprint returned the previous token, so the next
+                # tick simply retries once the write completes.  Never
+                # reload off a torn read.
+                self.stats.count("torn_polls")
+                return "unchanged"
             if fp == self._fingerprint:
                 return "unchanged"
             restored = self.ckpt.restore(skip_unhealthy=True)
@@ -385,10 +414,57 @@ class InferenceEngine:
                    skip_unhealthy: bool) -> str:
         try:
             faults.maybe_fault("serve.reload")
+            if step is not None and int(step) < 0:
+                # rollback target "-1": the fresh-init fallback params
+                # (cold-start fleets pin there before any promotion)
+                if self._init_params is None:
+                    self.stats.count("reloads_refused")
+                    self.log("serve: reload to step -1 refused — no "
+                             "fresh-init fallback params")
+                    return "refused"
+                if self.params_step < 0:
+                    self._stale_reason = None
+                    return "unchanged"
+                self._prev_params = self._params
+                self._prev_step = self.params_step
+                self._params = self._init_params
+                self.params_step = -1
+                self._stale_reason = None
+                self.stats.count("reloads")
+                self.log("serve: reloaded to fresh-init params "
+                         "(step -1)")
+                return "reloaded"
+            if step is not None and int(step) == self.params_step:
+                # already serving the requested step — e.g. restoring
+                # a refused canary to a pinned step the checkpoint GC
+                # has since deleted.  The params are live in memory, so
+                # touching disk could only fail; by definition the
+                # engine is not stale either.
+                self._stale_reason = None
+                return "unchanged"
             fp = self.ckpt.fingerprint()
             restored = self.ckpt.restore(step=step,
                                          skip_unhealthy=skip_unhealthy)
             if restored is None:
+                if (step is not None and self._prev_params is not None
+                        and int(step) == self._prev_step):
+                    # the requested snapshot was GC'd off disk
+                    # (max_to_keep) but it is what this engine served
+                    # immediately before the current params — a canary
+                    # being restored to the pinned step.  Swap back
+                    # from memory; disk owes us nothing.
+                    prev_p, prev_s = self._prev_params, self._prev_step
+                    self._prev_params = self._params
+                    self._prev_step = self.params_step
+                    self._params = prev_p
+                    self.params_step = prev_s
+                    self._fingerprint = fp
+                    self._stale_reason = None
+                    self.stats.count("reloads")
+                    self.log(f"serve: reloaded to step {step} from "
+                             f"in-memory previous params (snapshot no "
+                             f"longer on disk)")
+                    return "reloaded"
                 self.stats.count("reloads_refused")
                 self._stale_reason = (
                     f"explicit reload to step {step} found nothing "
@@ -403,6 +479,8 @@ class InferenceEngine:
                 self._fingerprint = fp
                 self._stale_reason = None
                 return "unchanged"
+            self._prev_params = self._params
+            self._prev_step = self.params_step
             self._swap(p, got)
             self._fingerprint = fp
             self._stale_reason = None

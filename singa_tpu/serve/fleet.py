@@ -139,7 +139,13 @@ class RolloutController:
     # -- lifecycle ----------------------------------------------------------
     def start(self, pinned_step: int) -> "RolloutController":
         self.pinned_step = int(pinned_step)
-        self._fp = self.mgr.fingerprint()
+        # deliberately NOT pre-capturing the fingerprint: a checkpoint
+        # that landed between the engines loading and this start() would
+        # otherwise be invisible forever (fingerprint unchanged from
+        # here on, so OBSERVE never fires — the general form of the
+        # fleet-pinned-at--1 startup race).  With _fp = None the first
+        # tick always compares the latest step against the pinned one.
+        self._fp = None
         self._stop.clear()
         self._thread = threading.Thread(target=self._loop,
                                         name="fleet-rollout",
@@ -378,8 +384,11 @@ class RolloutController:
     def _restore_canary(self, name: Optional[str]) -> None:
         """Put the (possibly dead) canary back on the pinned step —
         best-effort: a dead engine is already quarantined and will be
-        re-pinned by readmission-time reload if needed."""
-        if name is None or self.pinned_step < 0:
+        re-pinned by readmission-time reload if needed.  A pinned step
+        of -1 (cold start: nothing ever promoted) restores the canary
+        to its fresh-init params via `reload(step=-1)` — without it a
+        rejected FIRST checkpoint would keep serving on the canary."""
+        if name is None:
             return
         try:
             self.router.handle_for(name).reload(step=self.pinned_step)
@@ -398,7 +407,8 @@ class RolloutController:
                     "canary_restarts": self.canary_restarts,
                     "promotions": self.promotions,
                     "rollbacks": self.rollbacks,
-                    "refusals": self.refusals}
+                    "refusals": self.refusals,
+                    "torn_polls": self.mgr.torn_polls}
 
 
 class EngineFleet:

@@ -91,6 +91,7 @@ class ServeStats:
         self.reloads = 0
         self.reload_failures = 0   # restore raised → kept old params
         self.reloads_refused = 0   # nothing newer / unhealthy walk-back
+        self.torn_polls = 0        # poll raced a live writer → no change
 
     # -- mutation ----------------------------------------------------------
     def count(self, field: str, n: int = 1) -> None:
@@ -222,7 +223,7 @@ class ServeStats:
                     "shed", "rejected", "generated_tokens", "batches",
                     "batched_requests", "batch_slots", "cb_steps",
                     "compiles", "reloads", "reload_failures",
-                    "reloads_refused")
+                    "reloads_refused", "torn_polls")
         gauges = ("queue_depth", "consecutive_batch_failures", "qps",
                   "qps_recent", "uptime_s", "p50_latency_ms",
                   "p95_latency_ms", "p50_queue_wait_ms",
@@ -273,6 +274,7 @@ class ServeStats:
                 "reloads": self.reloads,
                 "reload_failures": self.reload_failures,
                 "reloads_refused": self.reloads_refused,
+                "torn_polls": self.torn_polls,
             }
         out["qps"] = round(self.qps(), 3)
         out["qps_recent"] = round(self.qps_recent(), 3)

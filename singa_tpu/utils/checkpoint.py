@@ -25,6 +25,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import shutil
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -95,6 +96,14 @@ class CheckpointManager:
         self.dir = os.path.abspath(os.path.join(workspace, "checkpoints"))
         self.log = log_fn
         os.makedirs(self.dir, exist_ok=True)
+        # writer-concurrent polling state (fingerprint): the last token
+        # this manager handed out, the last manifest stat whose content
+        # parsed clean, and how many polls hit a mid-write/torn read
+        # and degraded to "no change"
+        self._last_fp: tuple = ((), None)
+        self._man_checked: Optional[tuple] = None
+        self._last_steps: List[int] = []
+        self.torn_polls = 0
         if _HAVE_ORBAX:
             self._mgr = ocp.CheckpointManager(
                 self.dir,
@@ -217,6 +226,19 @@ class CheckpointManager:
         state = {"params": params, "opt_state": opt_state,
                  "step": np.asarray(step)}
         if self._mgr is not None:
+            stepdir = os.path.join(self.dir, str(step))
+            if os.path.isdir(stepdir) and not self._finalized(step):
+                # the wreck of a previous writer killed mid-save of
+                # this very step (a resumed trainer replays through its
+                # death step).  Orbax treats the existing directory as
+                # "step already saved" and silently skips the write —
+                # the snapshot would be LOST while the manifest records
+                # a verdict for it — so clear the wreck first.
+                self.log(f"warning: clearing unfinalized checkpoint "
+                         f"directory for step {step} (previous writer "
+                         f"died mid-save); re-saving")
+                shutil.rmtree(stepdir, ignore_errors=True)
+                self._mgr.reload()
             self._mgr.save(step, args=ocp.args.StandardSave(state))
             self._mgr.wait_until_finished()
             if act == "torn":
@@ -253,17 +275,44 @@ class CheckpointManager:
         # mark the directory as holding current-layout checkpoints
         self._write_version()
 
+    def _finalized(self, step: int) -> bool:
+        """Whether an orbax step directory finished its save: orbax
+        writes `_CHECKPOINT_METADATA` last, so a directory without it
+        is a save in flight — or the wreck of a writer that died
+        mid-save (a real SIGKILL, not the injected `torn` kind)."""
+        return os.path.isfile(os.path.join(self.dir, str(step),
+                                           "_CHECKPOINT_METADATA"))
+
     def available_steps(self) -> List[int]:
-        """All snapshot steps present on disk, ascending (valid or not —
-        restore decides validity)."""
-        if self._mgr is not None:
-            # orbax caches the step list per manager instance; refresh
-            # from disk so a reader sees saves made by OTHER managers
-            # (the serving tier polls the trainer's workspace)
-            self._mgr.reload()
-            return sorted(self._mgr.all_steps())
-        return sorted(int(f[5:-4]) for f in os.listdir(self.dir)
-                      if f.startswith("step_") and f.endswith(".npz"))
+        """All *finalized* snapshot steps present on disk, ascending
+        (readable or not — restore decides validity).
+
+        Writer-concurrent contract (same as `fingerprint`): never
+        raises against a live writer.  A directory listing caught
+        mid-save/mid-rename returns the previous good listing (counted
+        in `torn_polls`), and an orbax step directory whose save never
+        finished — in flight right now, or orphaned by a writer killed
+        mid-save — is not listed, so a serving poll neither reloads a
+        half-written step nor crashes walking it."""
+        try:
+            if self._mgr is not None:
+                # orbax caches the step list per manager instance;
+                # refresh from disk so a reader sees saves made by
+                # OTHER managers (the serving tier polls the trainer's
+                # workspace)
+                self._mgr.reload()
+                steps = sorted(int(s) for s in self._mgr.all_steps()
+                               if self._finalized(int(s)))
+            else:
+                steps = sorted(int(f[5:-4])
+                               for f in os.listdir(self.dir)
+                               if f.startswith("step_")
+                               and f.endswith(".npz"))
+        except Exception:  # noqa: BLE001 — any torn/mid-write listing
+            self.torn_polls += 1
+            return list(self._last_steps)
+        self._last_steps = steps
+        return steps
 
     def latest_step(self) -> Optional[int]:
         steps = self.available_steps()
@@ -274,14 +323,36 @@ class CheckpointManager:
         set of snapshot steps on disk plus the MANIFEST.json stat
         (mtime_ns, size).  A new save — or a re-save carrying a new
         health verdict — changes it; comparing tokens costs two
-        directory stats, no file reads, so a server can poll every
-        second without touching snapshot data."""
+        directory stats (plus one manifest parse per *change*), so a
+        server can poll every second without touching snapshot data.
+
+        Writer-concurrent contract: this NEVER raises.  A reader racing
+        a live writer — a step list read mid-save, a MANIFEST.json
+        caught mid-rename or half-written — surfaces as "no change"
+        (the previous token is returned and `torn_polls` counts the
+        degrade), so a poll loop retries on its next tick instead of
+        crashing or reloading a torn step.  The parse check matters:
+        a torn manifest loses every health verdict, so acting on its
+        stat alone could hot-reload a DIVERGED snapshot as if it were
+        blessed."""
         try:
-            st = os.stat(self._manifest_path())
-            man = (st.st_mtime_ns, st.st_size)
-        except OSError:
-            man = None
-        return (tuple(self.available_steps()), man)
+            steps = tuple(self.available_steps())
+            try:
+                st = os.stat(self._manifest_path())
+                man = (st.st_mtime_ns, st.st_size)
+            except FileNotFoundError:
+                man = None
+            if man is not None and man != self._man_checked:
+                # the stat moved: prove the content is whole before
+                # handing out a token that would trigger reloads
+                with open(self._manifest_path()) as f:
+                    json.load(f)
+                self._man_checked = man
+        except Exception:  # noqa: BLE001 — any torn/mid-write read
+            self.torn_polls += 1
+            return self._last_fp
+        self._last_fp = (steps, man)
+        return self._last_fp
 
     def restore(self, step: Optional[int] = None,
                 template: Optional[Dict[str, Any]] = None,

@@ -51,6 +51,14 @@ restart — so a one-shot fault never re-fires during recovery):
                    an error mid-canary aborts the rollout safely:
                    the canary is rolled back to the pinned step and
                    the fleet never promotes)
+    pipeline.publish
+                   one checkpoint publication in the closed train-and-
+                   serve loop (PipelineController._on_publish — an
+                   error degrades to a counted `publish_faults`: the
+                   blessed step is still recorded and the rollout
+                   controller still notices the fingerprint change on
+                   its own poll, so a lost publish notification never
+                   loses a promotion)
     obs.emit       one telemetry record written (a span recorded, an
                    event-log line appended, a trace exported — every
                    obs write path swallows the fault into a drop
@@ -91,7 +99,8 @@ from typing import Dict, List, Optional
 SITES = ("data.decode", "data.prefetch", "feed.stage", "ckpt.save",
          "ckpt.restore", "sync.elastic", "sync.delta", "step.train",
          "step.grad", "serve.admit", "serve.batch", "serve.reload",
-         "fleet.dispatch", "fleet.rollout", "obs.emit")
+         "fleet.dispatch", "fleet.rollout", "pipeline.publish",
+         "obs.emit")
 
 KINDS = ("error", "preempt", "corrupt", "torn", "nan", "spike")
 

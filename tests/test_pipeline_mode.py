@@ -1,0 +1,514 @@
+"""Closed-loop train-and-serve pipeline (singa_tpu/core/pipeline.py):
+the trainer publishes checkpoints into a workspace the serving fleet
+promotes out of, concurrently.
+
+Correctness anchors:
+  * a checkpoint poll racing a LIVE writer (mid-rename, half-written
+    MANIFEST.json) reads as "no change" — counted `torn_polls`, never
+    an exception, never a reload of a torn step;
+  * a DIVERGED step is never served by more than the canary: the
+    manifest-verdict gate rolls it back, and on a cold start the
+    canary is restored to fresh-init params (step -1), not left on
+    the bad step;
+  * cold start → first publish promotes WITHOUT an engine restart —
+    the rollout must not pre-capture the fingerprint at start()
+    (a save landing between engine load and rollout start would be
+    invisible forever: the fleet-pinned-at--1 race);
+  * under continuous client load with a trainer restart mid-run,
+    every blessed checkpoint reaches traffic within bounded lag and
+    no response ever comes from below the promoted step.
+
+Cost control: rollout/controller logic is exercised through stub
+handles and fake fleets (ticks driven explicitly); exactly one test
+runs the real closed loop (tiny LM, 2 real engines, supervised
+trainer with an injected preemption)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from singa_tpu.core.pipeline import PipelineController, PipelineSpec
+from singa_tpu.serve import RolloutController, RolloutSpec, Router, RouterSpec
+from singa_tpu.utils.checkpoint import CheckpointManager
+from singa_tpu.utils.faults import FaultSchedule, inject
+
+from test_fleet import StubHandle, _net_and_params, _save
+
+pytestmark = pytest.mark.pipeline
+
+VOCAB, SEQ = 64, 16
+SHAPES = {"data": {"input": (SEQ,), "target": (SEQ,)}}
+
+
+def _params():
+    return {"w": np.arange(16, dtype=np.float32).reshape(4, 4)}
+
+
+# -- spec grammar ------------------------------------------------------------
+
+def test_pipeline_spec_parse_grammar():
+    s = PipelineSpec.parse("lag_alarm_s=5.5,join_s=120;seed=3")
+    assert s.lag_alarm_s == 5.5 and s.join_s == 120.0 and s.seed == 3
+    assert PipelineSpec.parse(None) == PipelineSpec()
+    assert PipelineSpec.parse("") == PipelineSpec()
+    with pytest.raises(ValueError, match="unknown key"):
+        PipelineSpec.parse("bogus=1")
+    with pytest.raises(ValueError):
+        PipelineSpec.parse("lag_alarm_s=0")
+
+
+# -- torn-poll hardening (satellite: fingerprint vs a live writer) -----------
+
+def test_fingerprint_torn_manifest_reads_as_no_change(tmp_path):
+    """A half-written MANIFEST.json (non-atomic writer, cross-fs
+    rename) must read as 'no change': the previous fingerprint comes
+    back, `torn_polls` counts it, nothing raises."""
+    mgr = CheckpointManager(str(tmp_path), log_fn=lambda s: None)
+    mgr.save(1, _params(), {"t": np.zeros(())},
+             health={"verdict": "ok"})
+    good = mgr.fingerprint()
+    assert mgr.torn_polls == 0
+    man = os.path.join(str(tmp_path), "checkpoints", "MANIFEST.json")
+    with open(man) as f:
+        full = f.read()
+    with open(man, "w") as f:
+        f.write(full[: len(full) // 2])     # torn mid-write
+    torn = mgr.fingerprint()
+    assert torn == good                     # the cached last-good fp
+    assert mgr.torn_polls == 1
+    with open(man, "w") as f:               # writer finishes
+        f.write(full)
+    healed = mgr.fingerprint()
+    assert healed[0] == good[0] and mgr.torn_polls == 1
+
+
+def test_fingerprint_never_raises_against_live_writer(tmp_path):
+    """Regression (the satellite's racing test): a real save loop in
+    one thread, a fingerprint/latest/verdict poll loop in another —
+    the reader must never see an exception and every completed
+    observation must be of a fully-written step."""
+    writer = CheckpointManager(str(tmp_path), log_fn=lambda s: None)
+    reader = CheckpointManager(str(tmp_path), log_fn=lambda s: None)
+    errors, seen = [], []
+    stop = threading.Event()
+
+    def poll():
+        try:
+            while not stop.is_set():
+                steps, _ = reader.fingerprint()
+                if steps:
+                    step = max(steps)
+                    # a visible step must read as classified-or-gone
+                    # (GC may delete it between the two reads), never
+                    # as an exception or a half-written verdict
+                    assert reader.health_verdict(step) in (None, "ok")
+                    seen.append(step)
+        except Exception as e:  # noqa: BLE001 — the regression
+            errors.append(e)
+
+    t = threading.Thread(target=poll, daemon=True)
+    t.start()
+    try:
+        for step in range(1, 13):
+            writer.save(step, _params(), {"t": np.zeros(())},
+                        health={"verdict": "ok"})
+    finally:
+        stop.set()
+        t.join(10.0)
+    assert not errors, errors
+    assert seen and max(seen) >= 1
+    # monotonic observation: polls never time-travel backwards past a
+    # step they already saw (max_to_keep GC deletes OLD steps only)
+    assert all(b >= a for a, b in zip(seen, seen[1:])), seen[:50]
+
+
+def test_engine_poll_reload_skips_torn_manifest(tmp_path):
+    """InferenceEngine.poll_reload against a torn manifest: 'unchanged'
+    + a counted stats torn_poll — never an exception, never a reload
+    of the torn state; the next clean poll reloads normally."""
+    from singa_tpu.serve import InferenceEngine, ServeSpec
+
+    net, params = _net_and_params()
+    mgr = CheckpointManager(str(tmp_path), log_fn=lambda s: None)
+    _save(mgr, 1, params)
+    eng = InferenceEngine(net, ServeSpec(), workspace=str(tmp_path),
+                          log_fn=lambda s: None)
+    eng.load()
+    assert eng.params_step == 1
+    _save(mgr, 2, params)                   # a newer step lands...
+    man = os.path.join(str(tmp_path), "checkpoints", "MANIFEST.json")
+    with open(man) as f:
+        full = f.read()                     # the completed 2-step manifest
+    with open(man, "w") as f:
+        f.write(full[: len(full) // 2])     # ...but the poll sees torn
+    assert eng.poll_reload() == "unchanged"
+    assert eng.params_step == 1
+    assert eng.stats.torn_polls == 1
+    with open(man, "w") as f:               # the writer's rename lands
+        f.write(full)
+    assert eng.poll_reload() == "reloaded"
+    assert eng.params_step == 2 and eng.stats.torn_polls == 1
+
+
+# -- cold-start races (satellite: the fleet-pinned-at--1 class) --------------
+
+def _cold_rollout(tmp_path, n=2, **spec_kw):
+    spec_kw.setdefault("poll_s", 0.05)
+    spec_kw.setdefault("window_s", 0.2)
+    spec_kw.setdefault("min_requests", 1)
+    stubs = [StubHandle(f"e{i}", step=-1) for i in range(n)]
+    router = Router(stubs, spec=RouterSpec(), log_fn=lambda s: None)
+    router.probe_all()
+    ctrl = RolloutController(router, str(tmp_path),
+                             spec=RolloutSpec(**spec_kw),
+                             log_fn=lambda s: None)
+    return ctrl, stubs
+
+
+def test_cold_start_first_publish_promotes_without_restart(tmp_path):
+    """A checkpoint that lands BEFORE rollout.start() must still be
+    noticed (start() must not pre-capture the fingerprint) and the
+    first blessed step must promote from a -1 cold start with no
+    engine restart."""
+    ctrl, stubs = _cold_rollout(tmp_path)
+    _, params = _net_and_params()
+    mgr = CheckpointManager(str(tmp_path), log_fn=lambda s: None)
+    _save(mgr, 1, params)                   # lands before start()
+    ctrl.start(-1)
+    ctrl.stop()                             # keep ticks hand-driven
+    ctrl.tick()                             # OBSERVE: sees step 1
+    assert ctrl.state == "CANARY" and ctrl.target_step == 1
+    canary = next(s for s in stubs if s.name == ctrl.canary)
+    canary.served += 3                      # canary traffic
+    ctrl._deadline = time.monotonic() - 1.0
+    ctrl.tick()                             # evaluate -> promote
+    assert ctrl.state == "OBSERVE" and ctrl.pinned_step == 1
+    assert ctrl.promotions == 1 and ctrl.rollbacks == 0
+    assert all(s.step == 1 for s in stubs)
+
+
+def test_cold_start_rejected_first_checkpoint_restores_fresh_init(
+        tmp_path):
+    """DIVERGED-never-ships, cold-start edition: the FIRST checkpoint
+    carries a bad manifest verdict — the canary must be rolled back to
+    fresh-init params (reload(step=-1)), and no second engine may ever
+    touch the bad step."""
+    ctrl, stubs = _cold_rollout(tmp_path)
+    _, params = _net_and_params()
+    mgr = CheckpointManager(str(tmp_path), log_fn=lambda s: None)
+    _save(mgr, 2, params, verdict="DIVERGED")
+    ctrl.pinned_step, ctrl._fp = -1, None   # start() without the thread
+    ctrl.tick()
+    assert ctrl.state == "CANARY"
+    canary = next(s for s in stubs if s.name == ctrl.canary)
+    others = [s for s in stubs if s is not canary]
+    assert canary.step == 2                 # exactly one engine on it
+    assert all(s.step == -1 for s in others)
+    canary.served += 3
+    ctrl._deadline = time.monotonic() - 1.0
+    ctrl.tick()                             # evaluate -> ROLLBACK
+    assert ctrl.rollbacks == 1 and ctrl.promotions == 0
+    assert canary.step == -1                # back on fresh-init params
+    assert canary.reloads[-1] == -1
+    for s in others:
+        assert 2 not in s.reloads           # the bad step never spread
+    # the rejected fingerprint is remembered: no canary ping-pong
+    ctrl.tick()
+    assert ctrl.state == "OBSERVE" and ctrl.canaries == 1
+
+
+def test_engine_reload_to_fresh_init(tmp_path):
+    """The engine half of the cold-start rollback: reload(step=-1)
+    restores the constructor's fresh-init params."""
+    from singa_tpu.serve import InferenceEngine, ServeSpec
+
+    net, params = _net_and_params()
+    mgr = CheckpointManager(str(tmp_path), log_fn=lambda s: None)
+    _save(mgr, 3, params)
+    eng = InferenceEngine(net, ServeSpec(), workspace=str(tmp_path),
+                          params=params, log_fn=lambda s: None)
+    eng.load()
+    assert eng.params_step == 3
+    assert eng.reload_to(-1) == "reloaded"
+    assert eng.params_step == -1 and eng.params is not None
+    assert eng.reload_to(-1) == "unchanged"
+
+
+def test_unfinalized_step_dir_is_invisible_and_resavable(tmp_path):
+    """A writer SIGKILLed mid-orbax-save leaves a step directory with
+    no metadata marker.  Readers must not list it — a canary must
+    never target a half-written step — and a resumed trainer's re-save
+    of that SAME step must actually land instead of being silently
+    swallowed by orbax's step-already-exists skip (which would record
+    a blessed verdict for a snapshot that does not exist)."""
+    mgr = CheckpointManager(str(tmp_path), log_fn=lambda s: None)
+    if mgr._mgr is None:
+        pytest.skip("orbax-layout behavior")
+    params = _params()
+    _save(mgr, 1, params)
+    os.makedirs(os.path.join(str(tmp_path), "checkpoints", "2"))
+    reader = CheckpointManager(str(tmp_path), log_fn=lambda s: None)
+    assert reader.available_steps() == [1]       # the wreck is invisible
+    steps, _ = reader.fingerprint()
+    assert steps == (1,)
+    _save(mgr, 2, params)                        # replay over the wreck
+    assert reader.available_steps() == [1, 2]
+    restored = reader.restore(step=2)
+    assert restored is not None and restored[2] == 2
+
+
+def test_reload_to_current_step_recovers_without_disk(tmp_path):
+    """Restoring a refused canary to a pinned step the checkpoint GC
+    has since deleted must succeed from memory ("unchanged") and clear
+    the stale-healthz flag — otherwise the engine reports degraded
+    forever, the router drops it, and with every engine burned the
+    fleet sheds all traffic."""
+    import shutil
+
+    from singa_tpu.serve import InferenceEngine, ServeSpec
+
+    net, params = _net_and_params()
+    mgr = CheckpointManager(str(tmp_path), log_fn=lambda s: None)
+    _save(mgr, 3, params)
+    eng = InferenceEngine(net, ServeSpec(), workspace=str(tmp_path),
+                          params=params, log_fn=lambda s: None)
+    eng.load()
+    assert eng.params_step == 3
+    # GC the snapshot out from under the engine, then hit it with a
+    # canary reload nothing on disk can satisfy: refused + stale
+    shutil.rmtree(os.path.join(str(tmp_path), "checkpoints", "3"),
+                  ignore_errors=True)
+    assert eng.reload_to(99) == "refused"
+    assert eng.health()["status"] == "degraded"
+    # the rollout's restore-to-pinned: the step it already serves
+    assert eng.reload_to(3) == "unchanged"
+    assert eng.params_step == 3
+    assert eng.health()["status"] == "ok"
+
+
+def test_canary_rollback_to_gcd_pinned_step_uses_memory(tmp_path):
+    """A long-pinned fleet outlives its own snapshot: with the trainer
+    saving every few seconds and max_to_keep=3, the pinned step is
+    GC'd off disk while the fleet still serves it.  Rolling a canary
+    back to the pinned step must then come from the engine's in-memory
+    previous params — a refusal here marks the canary stale/unhealthy
+    and (with every engine burned in turn) the fleet sheds all
+    traffic."""
+    import shutil
+
+    from singa_tpu.serve import InferenceEngine, ServeSpec
+
+    net, params = _net_and_params()
+    mgr = CheckpointManager(str(tmp_path), log_fn=lambda s: None)
+    _save(mgr, 3, params)
+    eng = InferenceEngine(net, ServeSpec(), workspace=str(tmp_path),
+                          params=params, log_fn=lambda s: None)
+    eng.load()
+    assert eng.params_step == 3          # the fleet's pinned step
+    _save(mgr, 5, params)
+    assert eng.reload_to(5) == "reloaded"  # canary to the new step
+    # GC deletes the pinned snapshot while the canary window runs
+    shutil.rmtree(os.path.join(str(tmp_path), "checkpoints", "3"),
+                  ignore_errors=True)
+    assert eng.reload_to(3) == "reloaded"  # rollback, from memory
+    assert eng.params_step == 3
+    assert eng.health()["status"] == "ok"
+
+
+# -- PipelineController over fakes -------------------------------------------
+
+class _FakeTrainer:
+    on_checkpoint = None
+
+
+class _FakeSupervisor:
+    def __init__(self):
+        self.trainer = _FakeTrainer()
+        self.failures = []
+
+
+class _FakeRollout:
+    def __init__(self):
+        self.pinned_step = -1
+
+
+class _FakeRouter:
+    def names(self):
+        return ["e0"]
+
+
+class _FakeFleet:
+    def __init__(self):
+        self.rollout = _FakeRollout()
+        self.router = _FakeRouter()
+
+    def snapshot(self):
+        return {"rollout": {"pinned_step": self.rollout.pinned_step}}
+
+
+def _controller(tmp_path, **spec_kw):
+    sup, fleet = _FakeSupervisor(), _FakeFleet()
+    ctl = PipelineController(sup, fleet, str(tmp_path),
+                             spec=PipelineSpec(**spec_kw),
+                             log_fn=lambda s: None)
+    return ctl, sup, fleet
+
+
+def test_controller_requires_a_rollout(tmp_path):
+    fleet = _FakeFleet()
+    fleet.rollout = None
+    with pytest.raises(ValueError, match="rollout"):
+        PipelineController(_FakeSupervisor(), fleet, str(tmp_path))
+
+
+def test_publish_blessing_and_lag_gauge(tmp_path):
+    """Only ok/None verdicts bless a step; the lag pair tracks blessed
+    minus served and drains (recording the promote latency) when the
+    rollout catches up."""
+    ctl, sup, fleet = _controller(tmp_path)
+    hook = sup.trainer.on_checkpoint
+    assert hook is not None                 # controller wired it
+    hook(4, "ok")
+    hook(8, None)
+    hook(12, "spike")                       # published, NOT blessed
+    assert ctl.published == 3 and ctl.unblessed == 1
+    lag = ctl.lag()
+    assert lag["blessed_step"] == 8 and lag["served_step"] == -1
+    assert lag["lag_steps"] == 9 and lag["lag_s"] >= 0.0
+    fleet.rollout.pinned_step = 8           # the fleet catches up
+    lag = ctl.lag()
+    assert lag["lag_steps"] == 0 and lag["lag_s"] == 0.0
+    assert len(ctl.promote_lags_s) == 2     # steps 4 and 8 drained
+    snap = ctl.snapshot()
+    assert snap["published"] == 3 and snap["blessed_step"] == 8
+    assert snap["train"]["done"] is False   # never started
+
+
+def test_publish_fault_degrades_to_counter(tmp_path):
+    """An injected pipeline.publish fault must not lose the blessing
+    (the rollout polls the fingerprint itself) and must never raise
+    back into the trainer."""
+    ctl, sup, _ = _controller(tmp_path)
+    sched = FaultSchedule.parse("pipeline.publish@1:error", seed=0)
+    with inject(sched):
+        sup.trainer.on_checkpoint(5, "ok")
+        sup.trainer.on_checkpoint(10, "ok")
+    assert [f.site for f in sched.fired] == ["pipeline.publish"]
+    assert ctl.publish_faults == 1
+    assert ctl.published == 2 and ctl.last_blessed_step == 10
+
+
+def test_lag_alarm_fires_once_per_blessed_step(tmp_path):
+    logs = []
+    sup, fleet = _FakeSupervisor(), _FakeFleet()
+    ctl = PipelineController(sup, fleet, str(tmp_path),
+                             spec=PipelineSpec(lag_alarm_s=0.01),
+                             log_fn=logs.append)
+    sup.trainer.on_checkpoint(3, "ok")
+    time.sleep(0.05)
+    ctl.lag()
+    ctl.lag()                               # same blessed step: no spam
+    alarms = [m for m in logs if "lag alarm" in m]
+    assert len(alarms) == 1 and "step 3" in alarms[0]
+
+
+def test_controller_metrics_registry(tmp_path):
+    from singa_tpu.obs.metrics import MetricsRegistry
+
+    ctl, sup, fleet = _controller(tmp_path)
+    reg = MetricsRegistry()
+    ctl.register_into(reg)
+    sup.trainer.on_checkpoint(6, "ok")
+    fleet.rollout.pinned_step = 6
+    text = reg.render_prometheus()
+    assert "singa_pipeline_blessed_step 6" in text
+    assert "singa_pipeline_served_step 6" in text
+    assert "singa_pipeline_lag_steps 0" in text
+    assert "singa_pipeline_published_total 1" in text
+
+
+# -- the one real closed loop ------------------------------------------------
+
+def test_pipeline_blessed_reaches_traffic_with_trainer_restart(tmp_path):
+    """The real loop, end to end on CPU: supervised tiny-LM trainer
+    (with an injected mid-run preemption) + a 2-engine fleet, under
+    continuous client load.  Every blessed checkpoint must reach
+    traffic (lag drains to zero), no response may come from below the
+    promoted step, and no client request may fail."""
+    import jax
+
+    from singa_tpu.core.supervisor import Supervisor
+    from singa_tpu.core.trainer import Trainer
+    from singa_tpu.models.transformer import (synthetic_token_batches,
+                                              transformer_lm)
+    from singa_tpu.serve import EngineFleet, ServeSpec
+    from singa_tpu.utils.health import HealthMonitor, HealthSpec
+
+    cfg = transformer_lm(vocab_size=VOCAB, num_layers=2, embed_dim=32,
+                         num_heads=4, head_dim=8, seq_len=SEQ,
+                         batchsize=4, train_steps=18)
+    cfg.checkpoint_frequency = 6
+    mon = HealthMonitor(HealthSpec(), log_fn=lambda s: None)
+    tr = Trainer(cfg, SHAPES, log_fn=lambda s: None, donate=False,
+                 health=mon)
+    sup = Supervisor(tr, str(tmp_path), max_restarts=3,
+                     log=lambda s: None)
+    net = tr.test_net or tr.train_net
+    fleet = EngineFleet.local(
+        net, ServeSpec.parse("buckets=2x6,max_new_tokens=4,"
+                             "batch_window_s=0.002"),
+        2, workspace=str(tmp_path),
+        params=net.init_params(jax.random.PRNGKey(0)),
+        rollout_spec=RolloutSpec(poll_s=0.1, window_s=0.25,
+                                 min_requests=1),
+        log_fn=lambda s: None)
+    ctl = PipelineController(sup, fleet, str(tmp_path),
+                             spec=PipelineSpec(lag_alarm_s=60),
+                             log_fn=lambda s: None)
+
+    sched = FaultSchedule.parse("step.train@10:preempt", seed=0)
+    prompt = np.arange(1, 6, dtype=np.int32)
+    failures, responses = 0, []
+    with inject(sched):
+        ctl.start(lambda: synthetic_token_batches(4, SEQ, VOCAB,
+                                                  seed=5), seed=0)
+        try:
+            deadline = time.monotonic() + 240.0
+            while time.monotonic() < deadline:
+                done = not ctl.train_running()
+                lag = ctl.lag()
+                pinned_before = fleet.rollout.pinned_step
+                try:
+                    out = ctl.generate(prompt)
+                    responses.append((pinned_before, out["step"]))
+                except Exception:  # noqa: BLE001 — counted, asserted 0
+                    failures += 1
+                if done and lag["lag_steps"] == 0 and \
+                        lag["blessed_step"] >= 0:
+                    break
+            assert ctl.wait(timeout=30.0), "training never finished"
+        finally:
+            ctl.stop()
+
+    assert ctl.train_error is None, ctl.train_error
+    # the preemption fired and the supervisor absorbed it mid-pipeline
+    assert [f.kind for f in sup.failures] == ["preemption"]
+    assert failures == 0, f"{failures} client-visible failures"
+    # every blessed checkpoint reached traffic: loop fully drained
+    lag = ctl.lag()
+    assert lag["blessed_step"] == 18
+    assert lag["served_step"] == 18 and lag["lag_steps"] == 0
+    assert fleet.rollout.promotions >= 1
+    assert fleet.rollout.rollbacks == 0
+    # no response ever came from below the promoted step (cold-start
+    # fresh-init responses are step -1 == the pinned step then)
+    for pinned_before, step in responses:
+        assert step >= pinned_before, (pinned_before, step)
+    # ...and only blessed steps (or fresh-init) were ever served
+    served_steps = {s for _, s in responses}
+    assert served_steps <= {-1, 6, 12, 18}, served_steps
+    # bounded lag: blessed-to-served, as observed at poll time
+    assert ctl.promote_lags_s and max(ctl.promote_lags_s) < 120.0
