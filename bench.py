@@ -1222,6 +1222,215 @@ def _convergence_aux():
         return {}
 
 
+def bench_traffic_smoke(out=None):
+    """ISSUE 11 acceptance: the SLO-driven autoscaler under adversarial
+    open-loop traffic on CPU — a 1-engine fleet rides a ramp -> flash
+    crowd -> decay -> quiet schedule and the run FAILS (raises) unless:
+      * the fleet GREW under the flash crowd (scale_ups >= 1, peak
+        engine count above the starting size) and SHRANK back once
+        quiet (scale_downs >= 1, final count below peak) — capacity
+        followed the workload in both directions;
+      * p95 stayed inside the SLO outside the spike (gated on the
+        quiet phase: the steady state the autoscaler converged to);
+      * zero non-shed failures and zero harness drops — every offered
+        request completed or was shed with Overloaded, nothing else;
+      * retiring the engine that holds a live slow-reader stream with
+        drain=True delivers EVERY token and the done event before the
+        member leaves — scale-down never drops an in-flight stream.
+    Records per-phase offered/completed/shed and percentiles, the
+    autoscaler outcome counters, and the engine-count trajectory;
+    `out` writes the JSON line to a file as well
+    (scripts/traffic_smoke.sh -> BENCH_pr11.json)."""
+    import tempfile
+    import threading
+
+    import jax
+
+    from singa_tpu.core.net import build_net
+    from singa_tpu.models.transformer import transformer_lm
+    from singa_tpu.serve import (EngineFleet, RolloutSpec, RouterSpec,
+                                 ServeSpec)
+    from singa_tpu.serve.autoscale import AutoScaler, AutoScaleSpec
+    from singa_tpu.serve.traffic import (TrafficGen, flash_crowd, ramp,
+                                         steady)
+    from singa_tpu.utils.checkpoint import CheckpointManager
+
+    vocab, seq = 64, 16
+    cfg = transformer_lm(vocab_size=vocab, num_layers=2, embed_dim=32,
+                         num_heads=4, head_dim=8, seq_len=seq,
+                         batchsize=2)
+    net = build_net(cfg, "kTest",
+                    {"data": {"input": (seq,), "target": (seq,)}})
+    params = net.init_params(jax.random.PRNGKey(0))
+
+    ws = tempfile.mkdtemp(prefix="traffic_smoke_")
+    mgr = CheckpointManager(ws, log_fn=lambda s: None)
+    mgr.save(1, params, {"t": np.zeros(())}, health={"verdict": "ok"})
+
+    # 2 slots + a 4-deep queue caps one engine well under the flash
+    # rate: the ramp fits, the flash does not — the spike has to be
+    # answered with capacity, not absorbed
+    spec = ServeSpec(buckets=((2, 16),), max_new_tokens=48,
+                     batch_window_s=0.002, request_timeout_s=30.0,
+                     queue_capacity=4, cb="on", cb_slots=2,
+                     cb_block_len=8)
+    ascale = AutoScaleSpec(slo_p95_ms=1000.0, max_shed_rate=0.02,
+                           min_engines=1, max_engines=3,
+                           cooldown_s=1.0, window_s=1.5, tick_s=0.1,
+                           quiet_ticks=10, queue_high=4.0,
+                           occ_high=0.9, drain_timeout_s=20.0)
+    fleet = EngineFleet.local(
+        net, spec, 1, workspace=ws, params=params,
+        router_spec=RouterSpec(probe_period_s=0.05,
+                               quarantine_after=3),
+        rollout_spec=RolloutSpec(poll_s=0.2, window_s=0.5),
+        log_fn=lambda s: None)
+    fleet.start()
+    scaler = AutoScaler(fleet, spec=ascale, log_fn=lambda s: None)
+    scaler.start()
+
+    # engine-count trajectory, sampled while traffic runs
+    sizes = []
+    stop_sampling = threading.Event()
+
+    def sample():
+        while not stop_sampling.wait(0.05):
+            sizes.append(len([m for m in fleet.router.members()
+                              if not m.get("draining")]))
+
+    sampler = threading.Thread(target=sample, daemon=True)
+    sampler.start()
+
+    gen = TrafficGen(
+        lambda toks: fleet.generate(toks.tolist()),
+        stream_fn=lambda toks, max_new=None: fleet.generate_stream(
+            toks.tolist(), max_new=max_new),
+        vocab=vocab, seed=0, log_fn=lambda s: None)
+    phases = [ramp("ramp", 4.0, 2.0, 6.0, prompt_lens=(4, 8)),
+              flash_crowd("flash", 5.0, 6.0, k=20.0,
+                          prompt_lens=(4, 8)),
+              ramp("decay", 4.0, 6.0, 2.0, prompt_lens=(4, 8)),
+              steady("quiet", 6.0, 1.0, prompt_lens=(4,))]
+    rep = gen.run(phases, drain_timeout_s=30.0)
+
+    # idle tail: give the quiet-streak hysteresis room to scale down
+    deadline = time.time() + 20
+    while time.time() < deadline and scaler.scale_downs == 0:
+        time.sleep(0.1)
+    time.sleep(0.3)                      # let a draining member leave
+    stop_sampling.set()
+    sampler.join(2.0)
+    scaler.stop()
+
+    # -- drain sub-test: retire the engine holding a live stream ------
+    while len(fleet.router.names()) < 2:
+        fleet.grow()
+    probe = np.arange(1, 5, dtype=np.int32).tolist()
+    stream_events, stream_errors = [], []
+    started = threading.Event()
+
+    def slow_reader():
+        try:
+            for ev in fleet.generate_stream(probe, max_new=6):
+                started.set()
+                stream_events.append(ev)
+                if "token" in ev:
+                    time.sleep(0.05)     # slower than the decode loop
+        except Exception as e:  # noqa: BLE001 — surfaced in gates
+            stream_errors.append(repr(e))
+            started.set()
+
+    reader = threading.Thread(target=slow_reader)
+    reader.start()
+    started.wait(10.0)
+    victim = None
+    deadline = time.time() + 5
+    while time.time() < deadline and victim is None:
+        for m in fleet.router.members():
+            if m["in_flight"] > 0:
+                victim = m["name"]
+                break
+        if victim is None:
+            time.sleep(0.01)
+    stream_drained = (fleet.retire(victim, drain=True, timeout_s=20.0)
+                      if victim is not None else False)
+    reader.join(30.0)
+    fleet.stop()
+
+    sc = scaler.snapshot()
+    tot = rep["totals"]
+    quiet_row = next(r for r in rep["phases"] if r["name"] == "quiet")
+    peak = max(sizes) if sizes else 1
+    final = sizes[-1] if sizes else 1
+    got_done = any(ev.get("done") for ev in stream_events)
+    n_tokens = sum(1 for ev in stream_events if "token" in ev)
+
+    failures = []
+    if sc["scale_ups"] < 1 or peak <= 1:
+        failures.append(f"fleet never grew under the flash crowd "
+                        f"(scale_ups={sc['scale_ups']}, peak={peak})")
+    if sc["scale_downs"] < 1 or final >= peak:
+        failures.append(f"fleet never shrank after the spike "
+                        f"(scale_downs={sc['scale_downs']}, "
+                        f"peak={peak}, final={final})")
+    if quiet_row["p95_ms"] is not None and \
+            quiet_row["p95_ms"] > ascale.slo_p95_ms:
+        failures.append(f"quiet-phase p95 {quiet_row['p95_ms']}ms "
+                        f"blew the {ascale.slo_p95_ms}ms SLO")
+    if tot["failed"] != 0:
+        failures.append(f"non-shed failures: {tot['failed']} "
+                        f"({tot['errors'][:3]})")
+    if tot["dropped_harness"] != 0:
+        failures.append(f"harness dropped {tot['dropped_harness']} "
+                        f"arrivals (raise max_outstanding)")
+    if victim is None:
+        failures.append("drain sub-test never saw the stream's "
+                        "in-flight slot")
+    if stream_errors or not got_done or not stream_drained:
+        failures.append(f"scale-down dropped an in-flight stream: "
+                        f"errors={stream_errors}, done={got_done}, "
+                        f"drained={stream_drained}, "
+                        f"tokens={n_tokens}")
+    if failures:
+        raise RuntimeError("traffic smoke FAILED: "
+                           + "; ".join(failures))
+
+    result = {
+        "metric": "traffic_smoke_quiet_p95_latency",
+        "value": quiet_row["p95_ms"],
+        "unit": "ms",
+        "slo_p95_ms": ascale.slo_p95_ms,
+        "offered": tot["offered"],
+        "completed": tot["completed"],
+        "shed": tot["shed"],
+        "failed": tot["failed"],
+        "shed_rate": tot["shed_rate"],
+        "p50_ms": tot["p50_ms"],
+        "p95_ms": tot["p95_ms"],
+        "p99_ms": tot["p99_ms"],
+        "phases": [{k: r[k] for k in ("name", "offered", "completed",
+                                      "shed", "p95_ms")}
+                   for r in rep["phases"]],
+        "engines_start": 1,
+        "engines_peak": peak,
+        "engines_final": final,
+        "scale_ups": sc["scale_ups"],
+        "scale_downs": sc["scale_downs"],
+        "holds": sc["holds"],
+        "aborts": sc["aborts"],
+        "drained_clean": sc["drained_clean"],
+        "drain_timeouts": sc["drain_timeouts"],
+        "stream_drain_tokens": n_tokens,
+        "stream_drained": stream_drained,
+        "backend": jax.default_backend(),
+    }
+    line = json.dumps(result)
+    if out:
+        with open(out, "w") as f:
+            f.write(line + "\n")
+    return result
+
+
 def main() -> None:
     if "--cpu-baseline" in sys.argv:
         bench_cpu_baseline()
@@ -1255,6 +1464,12 @@ def main() -> None:
         if "--out" in sys.argv:
             out = sys.argv[sys.argv.index("--out") + 1]
         print(json.dumps(bench_cb_smoke(out=out)))
+        return
+    if "--traffic-smoke" in sys.argv:
+        out = None
+        if "--out" in sys.argv:
+            out = sys.argv[sys.argv.index("--out") + 1]
+        print(json.dumps(bench_traffic_smoke(out=out)))
         return
     if "--obs-overhead" in sys.argv:
         out = None

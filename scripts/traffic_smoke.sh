@@ -1,0 +1,67 @@
+#!/usr/bin/env bash
+# Traffic smoke (ISSUE 11 acceptance): the SLO-driven autoscaler under
+# adversarial open-loop traffic, on CPU.  FAILS unless
+#   * the 1-engine fleet GROWS under a flash crowd (scale_ups >= 1,
+#     peak engines above the start) and SHRINKS back once quiet
+#     (scale_downs >= 1, final below peak);
+#   * p95 stays inside the SLO outside the spike (gated on the quiet
+#     phase), with zero non-shed failures and zero harness drops;
+#   * retiring the engine that holds a live slow-reader stream with
+#     drain=True delivers every token and the done event first —
+#     scale-down never drops an in-flight stream.
+# Writes BENCH_pr11.json (per-phase offered/completed/shed +
+# percentiles, autoscaler outcome counters, engine-count trajectory).
+#
+# Usage: scripts/traffic_smoke.sh        (CPU-only, no data, ~2 min)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+# Leg 1: the bench smoke — ramp -> flash crowd -> decay -> quiet over
+# a growable in-process fleet.  bench_traffic_smoke raises (and this
+# script fails) unless every acceptance bullet holds.
+python bench.py --traffic-smoke --out BENCH_pr11.json
+
+# the recorded artifact must actually carry the numbers, not nulls
+python - <<'EOF'
+import json
+with open("BENCH_pr11.json") as f:
+    d = json.loads(f.read())
+for k in ("value", "offered", "completed", "shed"):
+    assert isinstance(d.get(k), (int, float)), \
+        f"BENCH_pr11.json: {k} missing/null: {d.get(k)}"
+assert d["failed"] == 0, d
+assert d["scale_ups"] >= 1 and d["scale_downs"] >= 1, d
+assert d["engines_peak"] > 1 and d["engines_final"] < d["engines_peak"], d
+assert d["value"] <= d["slo_p95_ms"], d
+assert d["stream_drained"] is True, d
+print(f"BENCH_pr11.json ok: quiet p95={d['value']}ms "
+      f"(SLO {d['slo_p95_ms']}ms), engines 1->{d['engines_peak']}->"
+      f"{d['engines_final']}, {d['scale_ups']} up/{d['scale_downs']} "
+      f"down, shed={d['shed']}/{d['offered']}, failed=0")
+EOF
+echo "TRAFFIC BENCH PASS: flash crowd answered with capacity, quiet"
+echo "  answered with drain-safe scale-down, zero non-shed failures"
+
+# Leg 2: the regression suite — control law, drain semantics,
+# canary-abort-on-retire, open-loop property, all on stub handles.
+python -m pytest tests/test_autoscale.py -q -m traffic \
+    -p no:cacheprovider
+
+# Leg 3: the CLI surface — `serve --fleet 1` with an --autoscale_spec
+# publishes the autoscaler snapshot in the smoke summary.
+python -m singa_tpu.main serve -model_conf examples/transformer/lm.conf \
+    --fleet 1 --smoke 6 \
+    --serve_spec 'buckets=2x8,max_new_tokens=4,batch_window_s=0.005' \
+    --autoscale_spec 'min_engines=1,max_engines=2,tick_s=0.1' \
+    | grep -E '"autoscale"' > /dev/null || {
+        echo "TRAFFIC SMOKE CLI LEG FAILED"; exit 1; }
+echo "TRAFFIC SMOKE CLI PASS"
+
+# Leg 4: the report — every BENCH_pr*.json lands in one table and the
+# new artifact is in it.
+python tools/bench_report.py | grep -E 'BENCH_pr11' > /dev/null || {
+    echo "BENCH REPORT LEG FAILED"; exit 1; }
+python tools/bench_report.py
+echo "TRAFFIC SMOKE PASS"

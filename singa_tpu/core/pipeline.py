@@ -112,6 +112,7 @@ class PipelineController:
 
     def __init__(self, supervisor, fleet, workspace: str,
                  spec: Optional[PipelineSpec] = None,
+                 autoscale_spec=None,
                  log_fn: Optional[Callable[[str], None]] = None):
         if fleet.rollout is None:
             raise ValueError(
@@ -139,6 +140,15 @@ class PipelineController:
         self.train_result = None    # (params, opt_state, history)
         self.train_error: Optional[BaseException] = None
         self._train_done = threading.Event()
+        # optional SLO-driven autoscaler: under pipeline mode the
+        # blessed→served lag joins its pressure signals, so a fleet
+        # too busy to promote is never shrunk
+        self.autoscaler = None
+        if autoscale_spec is not None:
+            from ..serve.autoscale import AutoScaler
+            self.autoscaler = AutoScaler(fleet, spec=autoscale_spec,
+                                         lag_fn=self.lag,
+                                         log_fn=self.log)
         supervisor.trainer.on_checkpoint = self._on_publish
 
     # -- lifecycle ----------------------------------------------------------
@@ -149,6 +159,8 @@ class PipelineController:
         then runs `Supervisor.run(train_iter_factory, **run_kw)` to
         completion, publishing on its checkpoint cadence."""
         self.fleet.start()
+        if self.autoscaler is not None:
+            self.autoscaler.start()
         obs.emit_event("pipeline.start",
                        pinned=self.fleet.rollout.pinned_step,
                        engines=len(self.fleet.router.names()))
@@ -202,6 +214,8 @@ class PipelineController:
         if self.train_running():
             self.log("warning: pipeline stopped while training still "
                      "runs; its checkpoints will land unserved")
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         self.fleet.stop()
 
     def __enter__(self) -> "PipelineController":
@@ -311,6 +325,8 @@ class PipelineController:
             ]
 
         registry.register_collector(collect)
+        if self.autoscaler is not None:
+            self.autoscaler.register_into(registry)
 
     # -- client passthrough + snapshot --------------------------------------
     def generate(self, tokens, timeout=None) -> Dict[str, Any]:
@@ -340,4 +356,6 @@ class PipelineController:
             "failures": len(self.supervisor.failures),
         }
         out["fleet"] = self.fleet.snapshot()
+        if self.autoscaler is not None:
+            out["autoscale"] = self.autoscaler.snapshot()
         return out

@@ -206,6 +206,14 @@ def make_serve_argparser() -> argparse.ArgumentParser:
                          "over the RolloutSpec fields, e.g. "
                          "'window_s=2,min_requests=10,p95_ratio=3' "
                          "(singa_tpu/serve/fleet.py)")
+    ap.add_argument("--autoscale_spec", default=None,
+                    help="enable the SLO-driven autoscaler over the "
+                         "fleet: comma-separated key=value over the "
+                         "AutoScaleSpec fields, e.g. 'slo_p95_ms=200,"
+                         "max_shed_rate=0.02,min_engines=1,"
+                         "max_engines=4,cooldown_s=5,window_s=10' "
+                         "(singa_tpu/serve/autoscale.py; needs "
+                         "--fleet, not --fleet_hostfile)")
     ap.add_argument("--pinned", action="store_true",
                     help="run this engine as a fleet member: never "
                          "self-reload; only the rollout controller's "
@@ -313,11 +321,14 @@ def _fleet_main(args, net, spec, fallback, schedule, log) -> int:
     driven in-process under --smoke)."""
     import json as _json
 
-    from .serve import EngineFleet, FleetServer, RolloutSpec, RouterSpec
+    from .serve import (AutoScaler, AutoScaleSpec, EngineFleet,
+                        FleetServer, RolloutSpec, RouterSpec)
     from .utils.faults import inject
 
     router_spec = RouterSpec.parse(args.fleet_spec)
     rollout_spec = RolloutSpec.parse(args.rollout_spec)
+    autoscale_spec = (AutoScaleSpec.parse(args.autoscale_spec)
+                      if args.autoscale_spec is not None else None)
     if args.pinned:
         log("warning: --pinned is a member flag; the fleet's workers "
             "are always pinned — ignoring")
@@ -335,10 +346,21 @@ def _fleet_main(args, net, spec, fallback, schedule, log) -> int:
                 net, spec, args.fleet, workspace=args.workspace,
                 params=fallback, router_spec=router_spec,
                 rollout_spec=rollout_spec, log_fn=log)
+        scaler = None
+        if autoscale_spec is not None:
+            if not fleet.can_grow():
+                log("warning: --autoscale_spec on an adopted "
+                    "(hostfile) fleet can only scale DOWN — spawning "
+                    "remote workers is deployment's job")
+            scaler = AutoScaler(fleet, spec=autoscale_spec, log_fn=log)
         reg = obs.registry()
         if reg is not None:
             fleet.router.stats.register_into(reg)
+            if scaler is not None:
+                scaler.register_into(reg)
         fleet.start()
+        if scaler is not None:
+            scaler.start()
         try:
             if args.smoke > 0:
                 import numpy as np
@@ -352,7 +374,10 @@ def _fleet_main(args, net, spec, fallback, schedule, log) -> int:
                     log(f"smoke {i}: plen={plen} -> "
                         f"{len(out['tokens'])} tokens on "
                         f"{out['engine']} (step {out['step']})")
-                print(_json.dumps(fleet.snapshot()))
+                snap = fleet.snapshot()
+                if scaler is not None:
+                    snap["autoscale"] = scaler.snapshot()
+                print(_json.dumps(snap))
                 return 0
             front = FleetServer(fleet, host=args.host, port=args.port,
                                 log_fn=log)
@@ -368,6 +393,8 @@ def _fleet_main(args, net, spec, fallback, schedule, log) -> int:
             finally:
                 front.stop()
         finally:
+            if scaler is not None:
+                scaler.stop()
             fleet.stop()
 
 
@@ -434,6 +461,11 @@ def make_pipeline_argparser() -> argparse.ArgumentParser:
                     help="PipelineSpec key=value entries, e.g. "
                          "'lag_alarm_s=10,join_s=600' "
                          "(singa_tpu/core/pipeline.py)")
+    ap.add_argument("--autoscale_spec", default=None,
+                    help="enable the SLO-driven autoscaler over the "
+                         "pipeline's fleet (AutoScaleSpec key=value "
+                         "entries; the blessed-to-served lag joins "
+                         "its pressure signals)")
     ap.add_argument("--smoke", type=int, default=0, metavar="N",
                     help="drive >= N in-process client requests while "
                          "training runs, wait for the loop to drain "
@@ -521,8 +553,8 @@ def pipeline_main(argv) -> int:
 
         import jax
 
-        from .serve import (EngineFleet, FleetServer, RolloutSpec,
-                            RouterSpec, ServeSpec)
+        from .serve import (AutoScaleSpec, EngineFleet, FleetServer,
+                            RolloutSpec, RouterSpec, ServeSpec)
         spec = (ServeSpec.parse(args.serve_spec) if args.serve_spec
                 else ServeSpec())
         net = trainer.test_net or trainer.train_net
@@ -534,7 +566,11 @@ def pipeline_main(argv) -> int:
             log_fn=obs.get_logger("fleet"))
         ctl = PipelineController(
             sup, fleet, args.workspace,
-            spec=PipelineSpec.parse(args.pipeline_spec), log_fn=log)
+            spec=PipelineSpec.parse(args.pipeline_spec),
+            autoscale_spec=(AutoScaleSpec.parse(args.autoscale_spec)
+                            if args.autoscale_spec is not None
+                            else None),
+            log_fn=log)
         if reg is not None:
             fleet.router.stats.register_into(reg)
             ctl.register_into(reg)

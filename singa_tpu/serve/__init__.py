@@ -33,13 +33,23 @@ checkpoints via atomic hot-reload.  Layers:
                  readmission, router-level shedding
     fleet.py     EngineFleet + RolloutController + FleetServer:
                  N workers behind one router, canary rollout with
-                 auto-rollback, streaming passthrough
+                 auto-rollback, streaming passthrough, elastic
+                 grow/retire membership
+    autoscale.py AutoScaler + AutoScaleSpec: SLO-driven control loop
+                 over the windowed stats — grow on pressure (shed
+                 rate, p95 vs budget, queue depth, occupancy), drain
+                 and retire after a quiet streak, Backoff cooldown
+    traffic.py   TrafficGen + Phase scenarios: open-loop Poisson
+                 load (steady/ramp/flash_crowd/diurnal), long-tail
+                 prompt mixes, slow readers, chaos hooks — offered
+                 vs completed, shed rate, p50/p95/p99 per phase
 
 Fault sites `serve.admit` / `serve.batch` / `serve.reload` /
-`fleet.dispatch` / `fleet.rollout` (utils.faults) make every
-degradation path deterministic on CPU.
+`fleet.dispatch` / `fleet.rollout` / `scale.decide` (utils.faults)
+make every degradation path deterministic on CPU.
 """
 
+from .autoscale import AutoScaler, AutoScaleSpec
 from .batcher import DeadlineExpired, MicroBatcher, Overloaded, Ticket
 from .engine import InferenceEngine, ServeSpec
 from .fleet import (EngineFleet, FleetServer, RolloutController,
@@ -51,11 +61,15 @@ from .router import (EngineUnavailable, HttpEngineHandle,
 from .scheduler import ContinuousScheduler, StreamTicket
 from .server import InferenceServer
 from .stats import ServeStats
+from .traffic import (Phase, TrafficGen, diurnal, flash_crowd, ramp,
+                      steady)
 
-__all__ = ["ContinuousScheduler", "DeadlineExpired", "EngineFleet",
-           "EngineUnavailable", "FleetServer", "HttpEngineHandle",
-           "InferenceEngine", "InferenceServer", "LocalEngineHandle",
-           "MicroBatcher", "Overloaded", "PagedKVCache",
+__all__ = ["AutoScaler", "AutoScaleSpec", "ContinuousScheduler",
+           "DeadlineExpired", "EngineFleet", "EngineUnavailable",
+           "FleetServer", "HttpEngineHandle", "InferenceEngine",
+           "InferenceServer", "LocalEngineHandle", "MicroBatcher",
+           "Overloaded", "PagedKVCache", "Phase",
            "RolloutController", "RolloutSpec", "Router", "RouterSpec",
            "RouterStats", "ServeSpec", "ServeStats", "StreamTicket",
-           "Ticket"]
+           "Ticket", "TrafficGen", "diurnal", "flash_crowd", "ramp",
+           "steady"]

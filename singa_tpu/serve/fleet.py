@@ -42,7 +42,16 @@ Fault sites: `fleet.dispatch` (router attempt — behaves exactly like
 an engine failure), `fleet.rollout` (controller tick — aborts the
 rollout safely: rollback, never promote).  Events: `fleet.canary`,
 `fleet.promote`, `fleet.rollback`, `fleet.quarantine`,
-`fleet.readmit` (docs/OBSERVABILITY.md).
+`fleet.readmit`, `fleet.join`, `fleet.retire`, `fleet.canary_abort`
+(docs/OBSERVABILITY.md).
+
+Membership is elastic (autoscale.py): `EngineFleet.grow()` spawns a
+warmed, pinned worker and only then shows it to the Router;
+`EngineFleet.retire(name, drain=True)` stops admissions, lets
+in-flight work (including held stream slots) finish, then drops the
+member.  A canary retired mid-rollout ABORTS the canary (counted as
+`canary_aborts`, never a rollback) and the unjudged step re-canaries
+on a survivor.
 """
 
 from __future__ import annotations
@@ -132,6 +141,7 @@ class RolloutController:
         self.promotions = 0
         self.rollbacks = 0
         self.refusals = 0
+        self.canary_aborts = 0   # canary engine retired mid-canary
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -205,7 +215,12 @@ class RolloutController:
             self.target_step = target
             return
         self.target_step = target
-        handle = self.router.handle_for(name)
+        try:
+            handle = self.router.handle_for(name)
+        except KeyError:
+            # picked engine retired between pick and use (autoscale
+            # scale-down race) — remember the target, retry next tick
+            return
         pre = self._engine_counts(handle)
         self._baseline_p95 = self.router.stats.latency_quantile(0.95)
         with obs.span("fleet.rollout", phase="canary", engine=name,
@@ -266,10 +281,17 @@ class RolloutController:
                 self.canary = None
                 self._begin_canary(newest)
                 return
-        # canary death / quarantine: roll back, never deadlock
         mem = {m["name"]: m for m in self.router.members()}
         m = mem.get(self.canary)
-        if m is None or m["quarantined"] or not m["healthy"]:
+        # canary deliberately retired (autoscale scale-down): the
+        # checkpoint was never judged, so this is an ABORT, not a
+        # rollback — the fingerprint stays eligible and re-canaries
+        # on a surviving engine next tick
+        if m is None or m.get("draining"):
+            self._abort_canary("canary engine retired mid-canary")
+            return
+        # canary death / quarantine: roll back, never deadlock
+        if m["quarantined"] or not m["healthy"]:
             self._rollback("canary engine died or degraded "
                            "mid-canary")
             return
@@ -290,7 +312,11 @@ class RolloutController:
         """The promotion gate: manifest verdict + canary health +
         error rate + p95, all against the pre-canary window."""
         name, target = self.canary, self.target_step
-        handle = self.router.handle_for(name)
+        try:
+            handle = self.router.handle_for(name)
+        except KeyError:
+            self._abort_canary("canary engine retired at evaluation")
+            return
         post = self._engine_counts(handle)
         served = post["completed"] - self._pre["completed"]
         if served < int(self.spec.min_requests) and \
@@ -339,9 +365,11 @@ class RolloutController:
             for other in self.router.names():
                 if other == name:
                     continue
-                handle = self.router.handle_for(other)
                 try:
+                    handle = self.router.handle_for(other)
                     got = handle.reload(step=target)
+                except KeyError:
+                    continue           # retired mid-promote: skip
                 except Exception as e:  # noqa: BLE001 — router will
                     got = {"outcome": "failed", "error": str(e)}
                 if got.get("outcome") not in ("reloaded", "unchanged"):
@@ -381,6 +409,25 @@ class RolloutController:
         obs.emit_event("fleet.rollback", engine=name, target=target,
                        why=why, pinned=self.pinned_step)
 
+    def _abort_canary(self, why: str) -> None:
+        """The canary engine was deliberately retired out from under
+        the rollout.  The checkpoint was never judged, so nothing is
+        rejected and no rollback is counted — clear the state and the
+        remembered fingerprint so OBSERVE re-canaries the same step on
+        a surviving engine next tick."""
+        name, target = self.canary, self.target_step
+        self.state = "OBSERVE"
+        self.canary = None
+        self.target_step = None
+        self._fp = None            # force OBSERVE to re-compare
+        self.canary_aborts += 1
+        self.log(f"fleet: canary of step {target} ABORTED "
+                 f"(engine {name}: {why}); step stays eligible and "
+                 f"re-canaries on a surviving engine")
+        self._restore_canary(name)  # best-effort; gone engine = no-op
+        obs.emit_event("fleet.canary_abort", engine=name,
+                       target=target, why=why)
+
     def _restore_canary(self, name: Optional[str]) -> None:
         """Put the (possibly dead) canary back on the pinned step —
         best-effort: a dead engine is already quarantined and will be
@@ -388,8 +435,8 @@ class RolloutController:
         of -1 (cold start: nothing ever promoted) restores the canary
         to its fresh-init params via `reload(step=-1)` — without it a
         rejected FIRST checkpoint would keep serving on the canary."""
-        if name is None:
-            return
+        if name is None or name not in self.router.names():
+            return                 # retired: nothing left to restore
         try:
             self.router.handle_for(name).reload(step=self.pinned_step)
         except Exception as e:  # noqa: BLE001 — dead canary
@@ -408,6 +455,7 @@ class RolloutController:
                     "promotions": self.promotions,
                     "rollbacks": self.rollbacks,
                     "refusals": self.refusals,
+                    "canary_aborts": self.canary_aborts,
                     "torn_polls": self.mgr.torn_polls}
 
 
@@ -432,6 +480,13 @@ class EngineFleet:
             if workspace else None)
         self._local = [h for h in handles
                        if isinstance(h, LocalEngineHandle)]
+        # autoscale support: `local()` stashes what it would take to
+        # spawn one more identical worker; adopted (HTTP) fleets can't
+        # grow from here (spawning remote processes is deployment's
+        # job, not the autoscaler's)
+        self._spawn_cfg: Optional[Dict[str, Any]] = None
+        self._next_idx = len(handles)
+        self._grow_lock = threading.Lock()
 
     # -- constructors -------------------------------------------------------
     @classmethod
@@ -457,9 +512,14 @@ class EngineFleet:
                                   log_fn=(lambda s, n=name:
                                           log_fn(f"[{n}] {s}")))
             handles.append(LocalEngineHandle(name, srv))
-        return cls(handles, workspace=workspace,
-                   router_spec=router_spec, rollout_spec=rollout_spec,
-                   log_fn=log_fn)
+        fleet = cls(handles, workspace=workspace,
+                    router_spec=router_spec,
+                    rollout_spec=rollout_spec, log_fn=log_fn)
+        fleet._spawn_cfg = dict(net=net, spec=spec,
+                                workspace=workspace, params=params,
+                                warmup_modes=tuple(warmup_modes))
+        fleet._next_idx = size
+        return fleet
 
     @classmethod
     def adopt(cls, urls: List[str], workspace: Optional[str] = None,
@@ -516,6 +576,63 @@ class EngineFleet:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+    # -- elastic membership (autoscaler surface) ----------------------------
+    def can_grow(self) -> bool:
+        return self._spawn_cfg is not None
+
+    def grow(self) -> str:
+        """Spawn, warm, and pin ONE new in-process worker, then hand
+        it to the Router.  The ordering is the contract: load +
+        warmup compiles + reload-to-pinned-step all happen BEFORE
+        `add_engine` — a cold engine must never eat live traffic.
+        Returns the new engine's name."""
+        cfg = self._spawn_cfg
+        if cfg is None:
+            raise RuntimeError("fleet cannot grow: not built with "
+                               "EngineFleet.local()")
+        with self._grow_lock:
+            name = f"engine-{self._next_idx}"
+            self._next_idx += 1
+        eng = InferenceEngine(
+            cfg["net"], cfg["spec"], workspace=cfg["workspace"],
+            params=cfg["params"],
+            log_fn=(lambda s, n=name: self.log(f"[{n}] {s}")),
+            pinned=True)
+        srv = InferenceServer(eng, http=False,
+                              warmup_modes=cfg["warmup_modes"],
+                              log_fn=(lambda s, n=name:
+                                      self.log(f"[{n}] {s}")))
+        h = LocalEngineHandle(name, srv)
+        h.start()                  # load + warmup compiles happen here
+        pinned = (self.rollout.pinned_step
+                  if self.rollout is not None else None)
+        if pinned is not None and pinned >= 0 and \
+                eng.params_step != pinned:
+            got = h.reload(step=pinned)
+            if int(got.get("step", -1)) != pinned:
+                h.stop()
+                raise RuntimeError(
+                    f"new engine {name} could not reach pinned step "
+                    f"{pinned} (landed {got.get('step')}); not joined")
+        self._local.append(h)
+        self.router.add_engine(h)
+        return name
+
+    def retire(self, name: str, drain: bool = True,
+               timeout_s: float = 30.0) -> bool:
+        """Drain and retire one worker through the Router's
+        membership path; stop its server once drained.  On a drain
+        timeout the handle is left running (still in `_local`) so
+        in-flight streams can finish — `stop()` cleans it up."""
+        drained = self.router.remove_engine(name, drain=drain,
+                                            timeout_s=timeout_s)
+        h = next((x for x in self._local if x.name == name), None)
+        if h is not None and (drained or not drain):
+            self._local.remove(h)
+            if h._alive:
+                h.stop()
+        return drained
 
     # -- client API ---------------------------------------------------------
     def generate(self, tokens, timeout=None) -> Dict[str, Any]:

@@ -50,6 +50,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -352,31 +353,77 @@ class _Member:
     bench_until: float = 0.0      # monotonic readmission-probe time
     dispatched: int = 0
     failed: int = 0
-    last_health: Dict[str, Any] = field(default_factory=dict)
+    draining: bool = False        # retiring: no new admissions, pops
+    last_health: Dict[str, Any] = field(default_factory=dict)  # when drained
 
 
 class RouterStats:
     """Aggregate router counters (RouterStats ≈ the fleet-level
-    ServeStats; per-engine detail lives in Router.members())."""
+    ServeStats; per-engine detail lives in Router.members()).
+
+    Beside the lifetime counters, `windowed()` reports rates over the
+    last `window_s` seconds — the autoscaler's control inputs.  A
+    cumulative shed counter can't distinguish "shed a lot at 9am" from
+    "shedding right now"; the windowed view can."""
 
     FIELDS = ("routed", "completed", "retried", "failed", "shed",
-              "quarantines", "readmissions")
+              "quarantines", "readmissions", "joins", "retires")
 
-    def __init__(self):
+    def __init__(self, window_s: float = 30.0):
+        self.window_s = float(window_s)
         self._lock = threading.Lock()
         for f in self.FIELDS:
             setattr(self, f, 0)
         self._latencies: List[float] = []
+        self._t0 = time.monotonic()
+        self._routed_t: deque = deque(maxlen=16384)   # arrival stamps
+        self._shed_t: deque = deque(maxlen=16384)
+        self._done_t: deque = deque(maxlen=16384)     # (stamp, latency)
 
     def count(self, fieldname: str, n: int = 1) -> None:
+        now = time.monotonic()
         with self._lock:
             setattr(self, fieldname, getattr(self, fieldname) + n)
+            if fieldname == "routed":
+                self._routed_t.extend([now] * n)
+            elif fieldname == "shed":
+                self._shed_t.extend([now] * n)
 
     def observe_latency(self, seconds: float) -> None:
         with self._lock:
             self._latencies.append(seconds)
             if len(self._latencies) > 4096:
                 del self._latencies[:2048]
+            self._done_t.append((time.monotonic(), seconds))
+
+    def windowed(self, window_s: Optional[float] = None) -> Dict[str, Any]:
+        """Rates over the trailing window (capped at uptime so a
+        young process isn't diluted toward zero)."""
+        now = time.monotonic()
+        with self._lock:
+            window = float(window_s if window_s is not None
+                           else self.window_s)
+            window = min(window, max(now - self._t0, 1e-6))
+            cut = now - window
+            routed = sum(1 for t in self._routed_t if t >= cut)
+            shed = sum(1 for t in self._shed_t if t >= cut)
+            lats = sorted(l for t, l in self._done_t if t >= cut)
+
+        def q(frac):
+            if not lats:
+                return None
+            return round(
+                lats[min(int(frac * len(lats)), len(lats) - 1)] * 1e3, 3)
+        return {
+            "window_s": round(window, 3),
+            "routed": routed,
+            "shed": shed,
+            "completed": len(lats),
+            "qps": round(len(lats) / window, 3),
+            "shed_rate": round(shed / max(routed, 1), 4),
+            "p50_latency_ms": q(0.5),
+            "p95_latency_ms": q(0.95),
+        }
 
     def latency_quantile(self, q: float) -> Optional[float]:
         with self._lock:
@@ -394,6 +441,10 @@ class RouterStats:
                                  if p50 is not None else None)
         out["p95_latency_ms"] = (round(p95 * 1e3, 3)
                                  if p95 is not None else None)
+        win = self.windowed()
+        out["qps_recent"] = win["qps"]
+        out["shed_rate_recent"] = win["shed_rate"]
+        out["p95_latency_recent_ms"] = win["p95_latency_ms"]
         return out
 
     def register_into(self, registry,
@@ -407,7 +458,9 @@ class RouterStats:
                           float(snap[k])) for k in self.FIELDS]
             out += [Sample(f"{prefix}_{k}", "gauge",
                            f"fleet router gauge {k!r}", float(snap[k]))
-                    for k in ("p50_latency_ms", "p95_latency_ms")
+                    for k in ("p50_latency_ms", "p95_latency_ms",
+                              "qps_recent", "shed_rate_recent",
+                              "p95_latency_recent_ms")
                     if snap.get(k) is not None]
             return out
 
@@ -473,17 +526,72 @@ class Router:
                 "step": m.step, "in_flight": m.in_flight,
                 "queue_depth": m.queue_depth,
                 "dispatched": m.dispatched, "failed": m.failed,
-                "quarantines": m.quarantines,
+                "quarantines": m.quarantines, "draining": m.draining,
             } for n, m in self._members.items()]
 
     def healthy_names(self) -> List[str]:
         with self._lock:
             return [n for n, m in self._members.items()
-                    if m.healthy and not m.quarantined]
+                    if m.healthy and not m.quarantined
+                    and not m.draining]
 
     def engine_step(self, name: str) -> int:
         with self._lock:
-            return self._members[name].step
+            m = self._members.get(name)
+            return m.step if m is not None else -1
+
+    # -- runtime membership (autoscaler surface) ----------------------------
+    def add_engine(self, handle) -> None:
+        """Admit a new worker at runtime.  The caller must hand over a
+        STARTED, warmed handle — the first probe below is a verdict,
+        not a warmup, and an unhealthy join simply stays out of
+        dispatch until it probes ok."""
+        with self._lock:
+            if handle.name in self._members:
+                raise ValueError(
+                    f"duplicate engine name: {handle.name!r}")
+            self._members[handle.name] = _Member(handle=handle)
+        self._probe_one(handle.name)   # first verdict before traffic
+        self.stats.count("joins")
+        self.log(f"fleet: engine {handle.name} joined "
+                 f"(step {self.engine_step(handle.name)})")
+        obs.emit_event("fleet.join", engine=handle.name,
+                       step=self.engine_step(handle.name))
+
+    def remove_engine(self, name: str, drain: bool = True,
+                      timeout_s: float = 30.0) -> bool:
+        """Retire a worker.  `drain=True` stops admissions immediately
+        (the member is excluded from `_pick` under the same lock that
+        admits) and waits for in-flight work — including held stream
+        slots — to finish before dropping the member; returns whether
+        the drain completed inside `timeout_s`.  Retirement is
+        deliberate, so the member record (strikes, quarantine history)
+        leaves with it — a re-added engine starts clean."""
+        with self._lock:
+            m = self._members.get(name)
+            if m is None:
+                return True            # already gone
+            m.draining = True          # no new picks from here on
+        drained = True
+        if drain:
+            deadline = time.monotonic() + float(timeout_s)
+            while True:
+                with self._lock:
+                    mm = self._members.get(name)
+                    busy = mm is not None and mm.in_flight > 0
+                if not busy:
+                    break
+                if time.monotonic() >= deadline:
+                    drained = False
+                    break
+                time.sleep(0.005)
+        with self._lock:
+            self._members.pop(name, None)
+        self.stats.count("retires")
+        self.log(f"fleet: engine {name} retired "
+                 f"({'drained' if drained else 'drain timed out'})")
+        obs.emit_event("fleet.retire", engine=name, drained=drained)
+        return drained
 
     # -- probing ------------------------------------------------------------
     def _probe_loop(self) -> None:
@@ -498,7 +606,10 @@ class Router:
             self._probe_one(name)
 
     def _probe_one(self, name: str) -> None:
-        m = self._members[name]
+        with self._lock:
+            m = self._members.get(name)
+        if m is None:
+            return                    # retired while we iterated
         now = time.monotonic()
         if m.quarantined and now < m.bench_until:
             return                    # still benched; don't even probe
@@ -528,8 +639,12 @@ class Router:
         """One probe/dispatch failure; `quarantine_after` consecutive
         strikes bench the engine for a Backoff delay that escalates
         with each consecutive quarantine (the ReplicaSet
-        poisoned-round policy, serving-side)."""
-        m = self._members[name]
+        poisoned-round policy, serving-side).  A member retired
+        mid-failure is not charged — its record is already gone."""
+        with self._lock:
+            m = self._members.get(name)
+        if m is None:
+            return
         with self._lock:
             m.strikes += 1
             m.healthy = False
@@ -559,7 +674,7 @@ class Router:
             cands = [(m.in_flight + m.queue_depth, n)
                      for n, m in self._members.items()
                      if n not in exclude and m.healthy
-                     and not m.quarantined]
+                     and not m.quarantined and not m.draining]
             if not cands:
                 return None
             _, name = min(cands)
@@ -568,7 +683,9 @@ class Router:
 
     def _release(self, name: str) -> None:
         with self._lock:
-            self._members[name].in_flight -= 1
+            m = self._members.get(name)
+            if m is not None:
+                m.in_flight -= 1
 
     def route(self, mode: str, tokens,
               timeout: Optional[float] = None) -> Dict[str, Any]:
@@ -590,7 +707,11 @@ class Router:
                 if name is None:
                     break
                 tried.add(name)
-                m = self._members[name]
+                with self._lock:
+                    m = self._members.get(name)
+                if m is None:          # force-retired between pick/use
+                    self.stats.count("retried")
+                    continue
                 try:
                     faults.maybe_fault("fleet.dispatch")
                     out = m.handle.request(mode, tokens,
@@ -655,7 +776,11 @@ class Router:
             if name is None:
                 break
             tried.add(name)
-            m = self._members[name]
+            with self._lock:
+                m = self._members.get(name)
+            if m is None:              # force-retired between pick/use
+                self.stats.count("retried")
+                continue
             try:
                 faults.maybe_fault("fleet.dispatch")
                 stream = m.handle.request_stream(tokens,
@@ -688,7 +813,8 @@ class Router:
         self._shed(why)
 
     def _wrap_stream(self, name: str, stream, t0: float):
-        m = self._members[name]
+        with self._lock:
+            m = self._members.get(name)
 
         def gen():
             finished = False
@@ -702,7 +828,8 @@ class Router:
                 self._release(name)
                 if finished:
                     with self._lock:
-                        m.dispatched += 1
+                        if m is not None:
+                            m.dispatched += 1
                         self._sheds_in_a_row = 0
                     self.stats.count("completed")
                     self.stats.observe_latency(time.monotonic() - t0)
@@ -729,7 +856,8 @@ class Router:
         with self._lock:
             cands = [(m.in_flight + m.queue_depth, n)
                      for n, m in self._members.items()
-                     if m.healthy and not m.quarantined]
+                     if m.healthy and not m.quarantined
+                     and not m.draining]
         return min(cands)[1] if cands else None
 
     def snapshot(self) -> Dict[str, Any]:

@@ -62,6 +62,17 @@ class ServeStats:
         self.qps_window_s = max(float(qps_window_s), 0.001)
         self._completions: deque = deque(
             maxlen=max(int(latency_window), 1))
+        # timestamped reservoirs for the windowed() view (autoscaler
+        # control inputs): (stamp, latency) per completion, stamps per
+        # shed
+        self._timed_lats: deque = deque(
+            maxlen=max(int(latency_window), 1))
+        self._shed_t: deque = deque(maxlen=max(int(latency_window), 1))
+        # (stamp, active_slots) per scheduler step: the lifetime
+        # cb_slot_occupancy average can't fall after the scheduler
+        # idles (no steps, no new samples), so the autoscaler reads
+        # occupancy over a trailing window instead
+        self._cb_t: deque = deque(maxlen=8192)
         # admission / completion
         self.submitted = 0
         self.completed = 0
@@ -97,6 +108,8 @@ class ServeStats:
     def count(self, field: str, n: int = 1) -> None:
         with self._lock:
             setattr(self, field, getattr(self, field) + n)
+            if field == "shed":
+                self._shed_t.extend([time.monotonic()] * n)
 
     def gauge(self, field: str, value: int) -> None:
         with self._lock:
@@ -121,7 +134,9 @@ class ServeStats:
         with self._lock:
             self.completed += 1
             self._latencies.append(seconds)
-            self._completions.append(time.monotonic())
+            now = time.monotonic()
+            self._completions.append(now)
+            self._timed_lats.append((now, seconds))
 
     def observe_request(self, queue_wait_s: float, service_s: float,
                         ntokens: int) -> None:
@@ -143,6 +158,7 @@ class ServeStats:
             self.cb_steps += 1
             self.cb_active_slot_steps += int(active_slots)
             self.cb_block_use_steps += int(blocks_in_use)
+            self._cb_t.append((time.monotonic(), int(active_slots)))
 
     # -- reads -------------------------------------------------------------
     def latency_quantile(self, q: float) -> Optional[float]:
@@ -177,6 +193,36 @@ class ServeStats:
             return self.cb_active_slot_steps / (
                 self.cb_steps * self.cb_slot_capacity)
 
+    def cb_slot_occupancy_recent(
+            self, window_s: float = 5.0) -> Optional[float]:
+        """TIME-weighted slot occupancy over the trailing window:
+        slot-seconds actually spent decoding / (window x capacity).
+        The per-step lifetime average is wrong twice for a scale-down
+        signal — it never falls once the scheduler idles (no steps, no
+        new samples), and a scheduler that only steps while busy
+        averages high even at 1 rps.  Here the gaps BETWEEN steps
+        count as idle time (per-step credit capped at 0.25s so a
+        stalled scheduler can't bank a giant interval), so this reads
+        ~1.0 under saturation and decays toward 0.0 within `window_s`
+        of the last request.  None before any cb step (cb off or not
+        yet warmed)."""
+        now = time.monotonic()
+        with self._lock:
+            if self.cb_steps == 0 or self.cb_slot_capacity == 0:
+                return None
+            window = min(float(window_s), max(now - self._t0, 1e-6))
+            cutoff = now - window
+            entries = [(t, a) for t, a in self._cb_t if t >= cutoff]
+            capacity = self.cb_slot_capacity
+        if not entries:
+            return 0.0
+        busy = 0.0
+        prev = cutoff
+        for t, a in entries:
+            busy += a * min(max(t - prev, 0.0), 0.25)
+            prev = t
+        return min(busy / (window * capacity), 1.0)
+
     def cb_block_utilization(self) -> Optional[float]:
         with self._lock:
             if self.cb_steps == 0 or self.cb_blocks_total == 0:
@@ -210,6 +256,35 @@ class ServeStats:
             n = sum(1 for t in self._completions if t >= cutoff)
         return n / window
 
+    def windowed(self, window_s: Optional[float] = None) -> Dict[str, Any]:
+        """Rates over the trailing window (default `qps_window_s`,
+        capped at uptime) — the engine-level sibling of
+        `RouterStats.windowed()`.  shed_rate is sheds over admission
+        attempts (sheds + completions) inside the window."""
+        now = time.monotonic()
+        with self._lock:
+            window = float(window_s if window_s is not None
+                           else self.qps_window_s)
+            window = min(window, max(now - self._t0, 1e-6))
+            cut = now - window
+            shed = sum(1 for t in self._shed_t if t >= cut)
+            lats = sorted(l for t, l in self._timed_lats if t >= cut)
+
+        def q(frac):
+            if not lats:
+                return None
+            return round(
+                lats[min(int(frac * len(lats)), len(lats) - 1)] * 1e3, 3)
+        return {
+            "window_s": round(window, 3),
+            "completed": len(lats),
+            "shed": shed,
+            "qps": round(len(lats) / window, 3),
+            "shed_rate": round(shed / max(shed + len(lats), 1), 4),
+            "p50_latency_ms": q(0.5),
+            "p95_latency_ms": q(0.95),
+        }
+
     def register_into(self, registry,
                       prefix: str = "singa_serve") -> None:
         """Register every snapshot field into an `obs.MetricsRegistry`
@@ -226,11 +301,13 @@ class ServeStats:
                     "reloads_refused", "torn_polls")
         gauges = ("queue_depth", "consecutive_batch_failures", "qps",
                   "qps_recent", "uptime_s", "p50_latency_ms",
-                  "p95_latency_ms", "p50_queue_wait_ms",
+                  "p95_latency_ms", "shed_rate_recent",
+                  "p95_latency_recent_ms", "p50_queue_wait_ms",
                   "p95_queue_wait_ms", "p50_service_ms",
                   "p95_service_ms", "p50_tokens_per_s",
                   "p95_tokens_per_s", "batch_occupancy",
-                  "cb_slot_occupancy", "cb_block_utilization",
+                  "cb_slot_occupancy", "cb_slot_occupancy_recent",
+                  "cb_block_utilization",
                   "cb_blocks_in_use", "cb_blocks_total")
 
         def collect():
@@ -251,6 +328,7 @@ class ServeStats:
                     self.latency_quantile(0.95))
         occ = self.occupancy()
         cb_occ = self.cb_slot_occupancy()
+        cb_occ_recent = self.cb_slot_occupancy_recent()
         cb_util = self.cb_block_utilization()
         with self._lock:
             out = {
@@ -278,6 +356,9 @@ class ServeStats:
             }
         out["qps"] = round(self.qps(), 3)
         out["qps_recent"] = round(self.qps_recent(), 3)
+        win = self.windowed()
+        out["shed_rate_recent"] = win["shed_rate"]
+        out["p95_latency_recent_ms"] = win["p95_latency_ms"]
         out["uptime_s"] = round(self.uptime_s(), 3)
         out["p50_latency_ms"] = (round(p50 * 1e3, 3)
                                  if p50 is not None else None)
@@ -297,6 +378,9 @@ class ServeStats:
                                   else None)
         out["cb_slot_occupancy"] = (round(cb_occ, 4)
                                     if cb_occ is not None else None)
+        out["cb_slot_occupancy_recent"] = (
+            round(cb_occ_recent, 4)
+            if cb_occ_recent is not None else None)
         out["cb_block_utilization"] = (round(cb_util, 4)
                                        if cb_util is not None else None)
         return out

@@ -1,0 +1,311 @@
+"""Adversarial open-loop traffic harness: scenario load generation.
+
+"Handles heavy traffic" is a claim until a load generator can refute
+it.  The critical property here is OPEN-LOOP arrivals: each request
+fires at its Poisson-scheduled instant whether or not earlier requests
+have completed.  A closed-loop client (issue → wait → issue) slows
+down exactly when the server does, so measured latency self-limits
+and overload is invisible; an open-loop generator keeps offering load,
+which is what a million independent users do.
+
+Scenarios compose from `Phase`s:
+
+    steady(...)       constant-rate Poisson arrivals
+    ramp(...)         rate sweeps linearly start→end (diurnal rise)
+    flash_crowd(...)  a step to k× the base rate (the retweet moment)
+    diurnal(...)      ramp up → plateau → ramp down, in one call
+
+Each `Phase` also carries the request-shape mix — long-tail prompt
+lengths and max_new choices with weights — plus a `stream_p` fraction
+of streaming requests, an optional `slow_reader_s` per-token consumer
+delay (the client on hotel wifi that holds a stream slot open), and
+an `on_start` hook for chaos legs (kill an engine mid-ramp).
+
+`TrafficGen.run(phases)` records, per phase and in total: offered vs
+completed load, sheds (`Overloaded` — the server protecting itself,
+not a failure), failures (everything else — always a bug), harness
+drops (the `max_outstanding` safety cap; counted, never silent), and
+p50/p95/p99 completion latency.  Completions are attributed to the
+phase that OFFERED them, so a flash crowd's backlog can't launder its
+latency into the decay phase.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .batcher import Overloaded
+
+
+@dataclass(frozen=True)
+class Phase:
+    """One scenario leg: an arrival process plus a request-shape mix.
+    `rate_end_rps` turns the leg into a linear ramp; weights need not
+    sum to 1 (normalized at sample time)."""
+    name: str
+    duration_s: float
+    rate_rps: float
+    rate_end_rps: Optional[float] = None
+    prompt_lens: Tuple[int, ...] = (4, 8)
+    prompt_weights: Optional[Tuple[float, ...]] = None
+    max_new: Tuple[int, ...] = (4,)
+    max_new_weights: Optional[Tuple[float, ...]] = None
+    stream_p: float = 0.0          # fraction routed as streams
+    slow_reader_s: float = 0.0     # per-token consumer stall (streams)
+    on_start: Optional[Callable[[], None]] = None   # chaos hook
+
+    def __post_init__(self):
+        if float(self.duration_s) <= 0:
+            raise ValueError(f"phase {self.name!r}: duration_s must "
+                             f"be > 0")
+        if float(self.rate_rps) <= 0:
+            raise ValueError(f"phase {self.name!r}: rate_rps must "
+                             f"be > 0")
+        if not 0 <= float(self.stream_p) <= 1:
+            raise ValueError(f"phase {self.name!r}: stream_p must be "
+                             f"in [0, 1]")
+
+    def rate_at(self, frac: float) -> float:
+        """Instantaneous arrival rate `frac` of the way through."""
+        if self.rate_end_rps is None:
+            return float(self.rate_rps)
+        return float(self.rate_rps) + (
+            float(self.rate_end_rps) - float(self.rate_rps)) * frac
+
+
+# -- scenario builders ------------------------------------------------------
+
+def steady(name: str, duration_s: float, rate_rps: float,
+           **kw) -> Phase:
+    return Phase(name=name, duration_s=duration_s, rate_rps=rate_rps,
+                 **kw)
+
+
+def ramp(name: str, duration_s: float, start_rps: float,
+         end_rps: float, **kw) -> Phase:
+    return Phase(name=name, duration_s=duration_s, rate_rps=start_rps,
+                 rate_end_rps=end_rps, **kw)
+
+
+def flash_crowd(name: str, duration_s: float, base_rps: float,
+                k: float = 5.0, **kw) -> Phase:
+    """A step to k× the base rate — the load a ramp-tuned fleet has
+    not provisioned for yet."""
+    return Phase(name=name, duration_s=duration_s,
+                 rate_rps=base_rps * float(k), **kw)
+
+
+def diurnal(base_rps: float, peak_rps: float, rise_s: float,
+            plateau_s: float, fall_s: float, **kw) -> List[Phase]:
+    return [ramp("diurnal-rise", rise_s, base_rps, peak_rps, **kw),
+            steady("diurnal-plateau", plateau_s, peak_rps, **kw),
+            ramp("diurnal-fall", fall_s, peak_rps, base_rps, **kw)]
+
+
+# -- generator --------------------------------------------------------------
+
+class _PhaseLog:
+    def __init__(self, name: str):
+        self.name = name
+        self.offered = 0
+        self.completed = 0
+        self.shed = 0
+        self.failed = 0
+        self.dropped_harness = 0
+        self.latencies: List[float] = []
+        self.errors: List[str] = []
+
+
+class TrafficGen:
+    """Open-loop Poisson load against a fleet-shaped target.
+
+    `request_fn(tokens)` runs one buffered request (e.g.
+    `fleet.generate`); `stream_fn(tokens, max_new)` (optional) returns
+    a token-event iterator (e.g. `fleet.generate_stream`).  Both may
+    raise `Overloaded` (counted as shed) — anything else is a failure.
+    `max_outstanding` bounds harness threads: an arrival past the cap
+    is counted `dropped_harness`, never silently skipped — the report
+    stays honest about the load actually offered."""
+
+    def __init__(self, request_fn: Callable[[Any], Any],
+                 stream_fn: Optional[Callable[..., Any]] = None,
+                 vocab: int = 64, seed: int = 0,
+                 max_outstanding: int = 512, log_fn=print):
+        self.request_fn = request_fn
+        self.stream_fn = stream_fn
+        self.vocab = int(vocab)
+        self.seed = int(seed)
+        self.max_outstanding = int(max_outstanding)
+        self.log = log_fn
+        self._lock = threading.Lock()
+        self._outstanding = 0
+        self._threads: List[threading.Thread] = []
+
+    # -- one request --------------------------------------------------------
+    def _sample(self, rng, choices, weights) -> int:
+        if weights is None:
+            return int(rng.choice(list(choices)))
+        w = np.asarray(weights, dtype=np.float64)
+        return int(rng.choice(list(choices), p=w / w.sum()))
+
+    def _fire(self, phase: Phase, log: _PhaseLog, rng_seed: int) -> None:
+        rng = np.random.default_rng(rng_seed)
+        plen = self._sample(rng, phase.prompt_lens,
+                            phase.prompt_weights)
+        mnew = self._sample(rng, phase.max_new, phase.max_new_weights)
+        tokens = rng.integers(1, self.vocab, size=plen).astype(np.int32)
+        as_stream = (self.stream_fn is not None
+                     and rng.random() < float(phase.stream_p))
+        t0 = time.monotonic()
+        try:
+            if as_stream:
+                for ev in self.stream_fn(tokens, max_new=mnew):
+                    if phase.slow_reader_s > 0 and "token" in ev:
+                        time.sleep(phase.slow_reader_s)
+            else:
+                self.request_fn(tokens)
+        except Overloaded:
+            with self._lock:
+                log.shed += 1
+            return
+        except Exception as e:  # noqa: BLE001 — non-shed failure
+            with self._lock:
+                log.failed += 1
+                if len(log.errors) < 5:
+                    log.errors.append(f"{type(e).__name__}: {e}")
+            return
+        lat = time.monotonic() - t0
+        with self._lock:
+            log.completed += 1
+            log.latencies.append(lat)
+
+    def _spawn(self, phase: Phase, log: _PhaseLog, seed: int) -> None:
+        with self._lock:
+            if self._outstanding >= self.max_outstanding:
+                log.dropped_harness += 1
+                return
+            self._outstanding += 1
+            log.offered += 1
+
+        def run():
+            try:
+                self._fire(phase, log, seed)
+            finally:
+                with self._lock:
+                    self._outstanding -= 1
+
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"traffic-{phase.name}")
+        self._threads.append(t)
+        t.start()
+
+    # -- the open loop ------------------------------------------------------
+    def run(self, phases: Sequence[Phase],
+            drain_timeout_s: float = 60.0) -> Dict[str, Any]:
+        """Drive every phase in order, then wait (bounded) for the
+        tail of in-flight requests.  Arrivals NEVER wait on
+        completions — the defining open-loop property."""
+        rng = np.random.default_rng(self.seed)
+        logs: List[_PhaseLog] = []
+        seq = 0
+        for phase in phases:
+            log = _PhaseLog(phase.name)
+            logs.append(log)
+            if phase.on_start is not None:
+                try:
+                    phase.on_start()
+                except Exception as e:  # noqa: BLE001 — chaos hook
+                    self.log(f"traffic: on_start hook for "
+                             f"{phase.name!r} failed: {e}")
+            t0 = time.monotonic()
+            end = t0 + float(phase.duration_s)
+            next_t = t0
+            while True:
+                now = time.monotonic()
+                if next_t >= end:
+                    break
+                if next_t > now:
+                    time.sleep(min(next_t - now, 0.05))
+                    continue
+                self._spawn(phase, log, self.seed + seq)
+                seq += 1
+                frac = (next_t - t0) / float(phase.duration_s)
+                rate = max(phase.rate_at(frac), 1e-6)
+                next_t += float(rng.exponential(1.0 / rate))
+            self.log(f"traffic: phase {phase.name!r} offered "
+                     f"{log.offered} over {phase.duration_s:.1f}s")
+        deadline = time.monotonic() + float(drain_timeout_s)
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._outstanding == 0:
+                    break
+            time.sleep(0.02)
+        with self._lock:
+            undrained = self._outstanding
+        if undrained:
+            self.log(f"traffic: {undrained} request(s) still in "
+                     f"flight after {drain_timeout_s}s drain")
+        self._threads = [t for t in self._threads if t.is_alive()]
+        return self._report(logs, phases)
+
+    # -- reporting ----------------------------------------------------------
+    @staticmethod
+    def _quantile(lats: List[float], q: float) -> Optional[float]:
+        if not lats:
+            return None
+        s = sorted(lats)
+        return round(s[min(int(q * len(s)), len(s) - 1)] * 1e3, 3)
+
+    def _report(self, logs: List[_PhaseLog],
+                phases: Sequence[Phase]) -> Dict[str, Any]:
+        out_phases = []
+        tot = _PhaseLog("total")
+        for log, phase in zip(logs, phases):
+            with self._lock:
+                lats = list(log.latencies)
+                row = {
+                    "name": log.name,
+                    "duration_s": float(phase.duration_s),
+                    "offered": log.offered,
+                    "completed": log.completed,
+                    "shed": log.shed,
+                    "failed": log.failed,
+                    "dropped_harness": log.dropped_harness,
+                    "qps_offered": round(
+                        log.offered / float(phase.duration_s), 3),
+                    "qps_completed": round(
+                        log.completed / float(phase.duration_s), 3),
+                    "p50_ms": self._quantile(lats, 0.50),
+                    "p95_ms": self._quantile(lats, 0.95),
+                    "p99_ms": self._quantile(lats, 0.99),
+                    "errors": list(log.errors),
+                }
+            out_phases.append(row)
+            tot.offered += log.offered
+            tot.completed += log.completed
+            tot.shed += log.shed
+            tot.failed += log.failed
+            tot.dropped_harness += log.dropped_harness
+            tot.latencies.extend(lats)
+            tot.errors.extend(log.errors)
+        return {
+            "phases": out_phases,
+            "totals": {
+                "offered": tot.offered,
+                "completed": tot.completed,
+                "shed": tot.shed,
+                "failed": tot.failed,
+                "dropped_harness": tot.dropped_harness,
+                "shed_rate": round(
+                    tot.shed / max(tot.offered, 1), 4),
+                "p50_ms": self._quantile(tot.latencies, 0.50),
+                "p95_ms": self._quantile(tot.latencies, 0.95),
+                "p99_ms": self._quantile(tot.latencies, 0.99),
+                "errors": tot.errors[:10],
+            },
+        }

@@ -59,6 +59,11 @@ restart — so a one-shot fault never re-fires during recovery):
                    controller still notices the fingerprint change on
                    its own poll, so a lost publish notification never
                    loses a promotion)
+    scale.decide   one autoscaler control tick (AutoScaler.tick — an
+                   error skips that tick's decision, counted in
+                   `decide_faults` and evented `scale.abort`; a
+                   faulted tick never spawns and NEVER retires an
+                   engine, so fault injection can't shrink a fleet)
     obs.emit       one telemetry record written (a span recorded, an
                    event-log line appended, a trace exported — every
                    obs write path swallows the fault into a drop
@@ -100,7 +105,7 @@ SITES = ("data.decode", "data.prefetch", "feed.stage", "ckpt.save",
          "ckpt.restore", "sync.elastic", "sync.delta", "step.train",
          "step.grad", "serve.admit", "serve.batch", "serve.reload",
          "fleet.dispatch", "fleet.rollout", "pipeline.publish",
-         "obs.emit")
+         "scale.decide", "obs.emit")
 
 KINDS = ("error", "preempt", "corrupt", "torn", "nan", "spike")
 

@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Tabulate the per-PR bench artifacts (BENCH_pr*.json) into one
+markdown table, sorted by PR number.
+
+Each smoke bench writes a single JSON line whose shape is its own
+(MFU numbers, fleet latencies, autoscaler outcomes, ...), so the
+table keeps the stable triple every artifact shares — metric, value,
+unit — and compresses the rest into a highlights column drawn from a
+fixed key list.  Unreadable or malformed artifacts get an error row
+instead of being skipped: a report that silently drops a PR reads as
+"that PR had no numbers".
+
+Usage: python tools/bench_report.py [repo_root]
+"""
+
+import glob
+import json
+import os
+import re
+import sys
+
+# shown (when present) in the highlights column, in this order
+HIGHLIGHT_KEYS = (
+    "p50_latency_ms", "p95_latency_ms", "p95_ms", "shed_rate",
+    "kill_recovery_s", "canaries", "promotions", "rollbacks",
+    "engines_peak", "engines_final", "scale_ups", "scale_downs",
+    "stream_drained", "tok_sec", "qps", "completed", "backend",
+)
+
+
+def _fmt(v):
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def _row(path):
+    name = os.path.basename(path)
+    m = re.search(r"BENCH_pr(\d+)\.json$", name)
+    pr = int(m.group(1)) if m else -1
+    try:
+        with open(path) as f:
+            d = json.loads(f.readline())
+    except (OSError, ValueError) as e:
+        return (pr, name, "(unreadable)", "-", "-",
+                f"{type(e).__name__}: {e}")
+    hi = "; ".join(f"{k}={_fmt(d[k])}" for k in HIGHLIGHT_KEYS
+                   if d.get(k) is not None)
+    return (pr, name, str(d.get("metric", "?")),
+            _fmt(d.get("value", "?")), str(d.get("unit", "?")), hi)
+
+
+def report(root=".") -> str:
+    paths = glob.glob(os.path.join(root, "BENCH_pr*.json"))
+    rows = sorted(_row(p) for p in paths)
+    lines = ["| PR | artifact | metric | value | unit | highlights |",
+             "|---:|----------|--------|------:|------|------------|"]
+    for pr, name, metric, value, unit, hi in rows:
+        lines.append(f"| {pr} | {name} | {metric} | {value} | {unit} "
+                     f"| {hi} |")
+    if not rows:
+        lines.append("| - | (no BENCH_pr*.json found) | | | | |")
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    print(report(sys.argv[1] if len(sys.argv) > 1 else "."))
