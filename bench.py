@@ -1431,6 +1431,236 @@ def bench_traffic_smoke(out=None):
     return result
 
 
+def bench_tail_smoke(out=None):
+    """ISSUE 12 acceptance: tail-tolerant serving on CPU, three legs —
+    the run FAILS (raises) unless every gate holds:
+      * HEDGE leg: two identical 3-engine fleets, one engine in each
+        turned into a straggler (`set_stall`); identical closed-loop
+        traffic.  Gates: hedged p99 <= 0.5x unhedged p99 (hedging cut
+        the tail at least 2x) with hedges <= 10% of routed (the
+        retry-budget bound, observed not just promised);
+      * BROWNOUT leg: a 2-engine fleet under open-loop overload with a
+        1:1:1 interactive/batch/best_effort mix.  Gates: retry
+        amplification (attempts/routed) <= 1.2x, interactive p95
+        holds the SLO while best_effort sheds (brownout engaged);
+      * DOA leg: requests arriving with an already-expired deadline
+        are counted `expired_on_arrival` and burn ZERO engine steps.
+    Records both p99s, the hedge rate, amplification, per-class
+    sheds/latency, and the DOA accounting; `out` writes the JSON line
+    to a file as well (scripts/tail_smoke.sh -> BENCH_pr12.json)."""
+    import tempfile
+    import threading
+
+    import jax
+
+    from singa_tpu.core.net import build_net
+    from singa_tpu.models.transformer import transformer_lm
+    from singa_tpu.serve import (DeadlineExpired, EngineFleet,
+                                 RouterSpec, ServeSpec)
+    from singa_tpu.serve.traffic import TrafficGen, stall_chaos, steady
+    from singa_tpu.utils.checkpoint import CheckpointManager
+
+    vocab, seq = 64, 16
+    cfg = transformer_lm(vocab_size=vocab, num_layers=2, embed_dim=32,
+                         num_heads=4, head_dim=8, seq_len=seq,
+                         batchsize=2)
+    net = build_net(cfg, "kTest",
+                    {"data": {"input": (seq,), "target": (seq,)}})
+    params = net.init_params(jax.random.PRNGKey(0))
+
+    def make_fleet(size, router_spec, queue_capacity=8):
+        ws = tempfile.mkdtemp(prefix="tail_smoke_")
+        mgr = CheckpointManager(ws, log_fn=lambda s: None)
+        mgr.save(1, params, {"t": np.zeros(())},
+                 health={"verdict": "ok"})
+        spec = ServeSpec(buckets=((2, seq),), max_new_tokens=4,
+                         batch_window_s=0.002, request_timeout_s=30.0,
+                         queue_capacity=queue_capacity, cb="on",
+                         cb_slots=2, cb_block_len=4)
+        fleet = EngineFleet.local(net, spec, size, workspace=ws,
+                                  params=params,
+                                  router_spec=router_spec,
+                                  log_fn=lambda s: None)
+        fleet.start()
+        return fleet
+
+    # -- leg 1: hedged vs unhedged tail under one straggler -----------
+    def hedge_leg(hedge):
+        rspec = RouterSpec(probe_period_s=0.05, quarantine_after=10,
+                           request_timeout_s=30.0, hedge=hedge,
+                           hedge_min_s=0.1, hedge_max_s=0.25)
+        fleet = make_fleet(3, rspec)
+        stall_chaos(fleet, stall_s=0.25)()   # latch the straggler
+        lats, errors = [], []
+        lock = threading.Lock()
+
+        def worker(i):
+            rng = np.random.default_rng(100 + i)
+            for _ in range(30):
+                toks = rng.integers(1, vocab, size=4).tolist()
+                t0 = time.monotonic()
+                try:
+                    fleet.generate(toks)
+                except Exception as e:  # noqa: BLE001 — gated below
+                    with lock:
+                        errors.append(repr(e))
+                    continue
+                with lock:
+                    lats.append(time.monotonic() - t0)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120.0)
+        snap = fleet.router.stats.snapshot()
+        cancelled = sum(fleet.router.handle_for(n).engine
+                        .stats.cancelled
+                        for n in fleet.router.names())
+        fleet.stop()
+        lats.sort()
+        p99 = (lats[min(int(0.99 * len(lats)), len(lats) - 1)] * 1e3
+               if lats else None)
+        return {"p99_ms": round(p99, 3) if p99 else None,
+                "completed": len(lats), "errors": errors,
+                "routed": snap["routed"], "hedges": snap["hedges"],
+                "hedge_wins": snap["hedge_wins"],
+                "cancelled": cancelled}
+
+    unhedged = hedge_leg("off")
+    hedged = hedge_leg("on")
+    hedge_rate = hedged["hedges"] / max(hedged["routed"], 1)
+    tail_ratio = (hedged["p99_ms"] / unhedged["p99_ms"]
+                  if hedged["p99_ms"] and unhedged["p99_ms"]
+                  else None)
+
+    # -- leg 2: brownout under an open-loop overload with a QoS mix ---
+    slo_p95_ms = 2000.0
+    rspec = RouterSpec(probe_period_s=0.05, quarantine_after=10,
+                       request_timeout_s=30.0, hedge="off",
+                       brownout_shed_rate=0.05)
+    fleet = make_fleet(2, rspec, queue_capacity=4)
+    for n in fleet.router.names():     # throttle so the offered load
+        fleet.router.handle_for(n).engine.set_stall(0.02)  # saturates
+    gen = TrafficGen(
+        lambda toks, priority="interactive": fleet.generate(
+            toks.tolist(), priority=priority),
+        vocab=vocab, seed=0, max_outstanding=512,
+        log_fn=lambda s: None)
+    rep = gen.run([steady("overload", duration_s=4.0, rate_rps=150.0,
+                          prompt_lens=(4,), max_new=(4,),
+                          priorities=("interactive", "batch",
+                                      "best_effort"),
+                          priority_weights=(1.0, 1.0, 1.0))],
+                  drain_timeout_s=60.0)
+    rsnap = fleet.router.stats.snapshot()
+    amplification = rsnap["attempts"] / max(rsnap["routed"], 1)
+    by_class = rep["totals"]["by_class"]
+    inter_p95 = (by_class.get("interactive") or {}).get("p95_ms")
+    be_sheds = (rsnap["shed_best_effort"]
+                + sum(fleet.router.handle_for(n).engine
+                      .stats.shed_best_effort
+                      for n in fleet.router.names()))
+
+    # -- leg 3: dead on arrival burns zero engine steps ---------------
+    idle_deadline = time.time() + 30
+    while time.time() < idle_deadline and any(
+            m["in_flight"] > 0 for m in fleet.router.members()):
+        time.sleep(0.05)
+    time.sleep(0.3)                      # let the decode loops drain
+    doa_before = rsnap["expired_on_arrival"]
+
+    def engine_steps():
+        return sum(fleet.router.handle_for(n).engine.stats.cb_steps
+                   for n in fleet.router.names())
+
+    steps_before = engine_steps()
+    doa_n = 5
+    doa_refused = 0
+    dead = time.monotonic() - 1.0
+    for _ in range(doa_n):
+        try:
+            fleet.generate([1, 2, 3], deadline=dead)
+        except DeadlineExpired:
+            doa_refused += 1
+    time.sleep(0.2)
+    steps_after = engine_steps()
+    expired = (fleet.router.stats.snapshot()["expired_on_arrival"]
+               - doa_before)
+    doa_steps_burned = steps_after - steps_before
+    fleet.stop()
+
+    gates = {
+        "tail_ratio": {"value": tail_ratio, "bound": 0.5,
+                       "op": "<=",
+                       "pass": bool(tail_ratio is not None
+                                    and tail_ratio <= 0.5)},
+        "hedge_rate": {"value": round(hedge_rate, 4), "bound": 0.10,
+                       "op": "<=", "pass": bool(hedge_rate <= 0.10)},
+        "retry_amplification": {
+            "value": round(amplification, 4), "bound": 1.2,
+            "op": "<=", "pass": bool(amplification <= 1.2)},
+        "interactive_p95": {
+            "value": inter_p95, "bound": slo_p95_ms, "op": "<=",
+            "pass": bool(inter_p95 is not None
+                         and inter_p95 <= slo_p95_ms)},
+        "best_effort_sheds": {"value": be_sheds, "bound": 1,
+                              "op": ">=",
+                              "pass": bool(be_sheds >= 1)},
+        "expired_on_arrival": {"value": expired, "bound": doa_n,
+                               "op": "==",
+                               "pass": bool(expired == doa_n
+                                            and doa_refused == doa_n)},
+        "doa_zero_steps": {"value": doa_steps_burned, "bound": 0,
+                           "op": "==",
+                           "pass": bool(doa_steps_burned == 0)},
+    }
+    failures = [f"{k}: {g['value']} not {g['op']} {g['bound']}"
+                for k, g in gates.items() if not g["pass"]]
+    if unhedged["errors"] or hedged["errors"]:
+        failures.append(f"hedge legs saw non-shed failures: "
+                        f"{(unhedged['errors'] + hedged['errors'])[:3]}")
+    if rep["totals"]["failed"] != 0:
+        failures.append(f"brownout leg non-shed failures: "
+                        f"{rep['totals']['errors'][:3]}")
+    if failures:
+        raise RuntimeError("tail smoke FAILED: " + "; ".join(failures))
+
+    result = {
+        "metric": "tail_smoke_p99_ratio",
+        "value": round(tail_ratio, 4),
+        "unit": "x",
+        "hedged_p99_ms": hedged["p99_ms"],
+        "unhedged_p99_ms": unhedged["p99_ms"],
+        "hedge_rate": round(hedge_rate, 4),
+        "hedges": hedged["hedges"],
+        "hedge_wins": hedged["hedge_wins"],
+        "cancelled": hedged["cancelled"],
+        "retry_amplification": round(amplification, 4),
+        "interactive_p95_ms": inter_p95,
+        "slo_p95_ms": slo_p95_ms,
+        "best_effort_sheds": be_sheds,
+        "brownout_sheds": rsnap["brownout_sheds"],
+        "shed_by_class": {
+            "interactive": rsnap["shed_interactive"],
+            "batch": rsnap["shed_batch"],
+            "best_effort": rsnap["shed_best_effort"]},
+        "offered": rep["totals"]["offered"],
+        "completed": rep["totals"]["completed"],
+        "shed": rep["totals"]["shed"],
+        "expired_on_arrival": expired,
+        "doa_steps_burned": doa_steps_burned,
+        "gates": gates,
+        "backend": jax.default_backend(),
+    }
+    line = json.dumps(result)
+    if out:
+        with open(out, "w") as f:
+            f.write(line + "\n")
+    return result
+
+
 def main() -> None:
     if "--cpu-baseline" in sys.argv:
         bench_cpu_baseline()
@@ -1470,6 +1700,12 @@ def main() -> None:
         if "--out" in sys.argv:
             out = sys.argv[sys.argv.index("--out") + 1]
         print(json.dumps(bench_traffic_smoke(out=out)))
+        return
+    if "--tail-smoke" in sys.argv:
+        out = None
+        if "--out" in sys.argv:
+            out = sys.argv[sys.argv.index("--out") + 1]
+        print(json.dumps(bench_tail_smoke(out=out)))
         return
     if "--obs-overhead" in sys.argv:
         out = None

@@ -1,0 +1,72 @@
+#!/usr/bin/env bash
+# Tail smoke (ISSUE 12 acceptance): tail-tolerant serving — deadlines,
+# hedged dispatch with a retry budget, priority-aware brownout — on
+# CPU.  FAILS unless
+#   * with one stalled engine in a 3-engine fleet, hedged p99 is at
+#     most HALF the unhedged p99 (>= 2x tail cut) while hedges stay
+#     <= 10% of routed traffic (the retry-budget bound, observed);
+#   * under open-loop overload with a 1:1:1
+#     interactive/batch/best_effort mix, retry amplification
+#     (attempts/routed) stays <= 1.2x and interactive p95 holds the
+#     SLO while best_effort sheds (brownout engaged, honest
+#     Retry-After);
+#   * requests whose deadline expired before arrival are refused as
+#     `expired_on_arrival` and burn ZERO engine steps.
+# Writes BENCH_pr12.json (both p99s, hedge rate, amplification,
+# per-class sheds/latency, DOA accounting, and a `gates` dict).
+#
+# Usage: scripts/tail_smoke.sh        (CPU-only, no data, ~2 min)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+# Leg 1: the bench smoke — hedge contrast, brownout under overload,
+# dead-on-arrival accounting.  bench_tail_smoke raises (and this
+# script fails) unless every acceptance bullet holds.
+python bench.py --tail-smoke --out BENCH_pr12.json
+
+# the recorded artifact must actually carry the numbers, not nulls,
+# and every gate it records must have passed
+python - <<'EOF'
+import json
+with open("BENCH_pr12.json") as f:
+    d = json.loads(f.read())
+for k in ("value", "hedged_p99_ms", "unhedged_p99_ms", "hedge_rate",
+          "retry_amplification", "interactive_p95_ms",
+          "best_effort_sheds", "expired_on_arrival"):
+    assert isinstance(d.get(k), (int, float)), \
+        f"BENCH_pr12.json: {k} missing/null: {d.get(k)}"
+assert d["value"] <= 0.5, d
+assert d["hedge_rate"] <= 0.10, d
+assert d["retry_amplification"] <= 1.2, d
+assert d["interactive_p95_ms"] <= d["slo_p95_ms"], d
+assert d["best_effort_sheds"] >= 1 and d["brownout_sheds"] >= 1, d
+assert d["expired_on_arrival"] >= 1 and d["doa_steps_burned"] == 0, d
+gates = d.get("gates")
+assert isinstance(gates, dict) and gates, "gates dict missing"
+bad = [k for k, g in gates.items() if not g.get("pass")]
+assert not bad, f"gates failed: {bad}"
+print(f"BENCH_pr12.json ok: hedged p99={d['hedged_p99_ms']}ms vs "
+      f"unhedged {d['unhedged_p99_ms']}ms ({d['value']}x), hedge "
+      f"rate {d['hedge_rate']}, amplification "
+      f"{d['retry_amplification']}x, interactive p95="
+      f"{d['interactive_p95_ms']}ms (SLO {d['slo_p95_ms']}ms), "
+      f"best_effort sheds {d['best_effort_sheds']}, DOA "
+      f"{d['expired_on_arrival']} at 0 engine steps")
+EOF
+echo "TAIL BENCH PASS: the straggler paid for itself, the budget held,"
+echo "  interactive held its SLO while best_effort browned out"
+
+# Leg 2: the regression suite — deadline propagation, hedge win/cancel,
+# budget exhaustion, brownout ordering, Retry-After escalation,
+# per-class stats, DOA zero-step accounting.
+python -m pytest tests/test_tail.py -q -m tail -p no:cacheprovider
+
+# Leg 3: the report — every BENCH_pr*.json lands in one table, the new
+# artifact is in it, and its recorded gates are checked (a listed
+# artifact with missing/failing gates exits non-zero).
+python tools/bench_report.py | grep -E 'BENCH_pr12' > /dev/null || {
+    echo "BENCH REPORT LEG FAILED"; exit 1; }
+python tools/bench_report.py
+echo "TAIL SMOKE PASS"

@@ -41,16 +41,26 @@ checkpoints via atomic hot-reload.  Layers:
                  and retire after a quiet streak, Backoff cooldown
     traffic.py   TrafficGen + Phase scenarios: open-loop Poisson
                  load (steady/ramp/flash_crowd/diurnal), long-tail
-                 prompt mixes, slow readers, chaos hooks — offered
-                 vs completed, shed rate, p50/p95/p99 per phase
+                 prompt mixes, QoS priority mixes, slow readers,
+                 chaos hooks (incl. stall_chaos stragglers) —
+                 offered vs completed, shed rate, p50/p95/p99 per
+                 phase and per class
+    qos.py       request-lifecycle QoS vocabulary: end-to-end
+                 deadline propagation (absolute in-process, remaining
+                 -ms on the wire), priority classes interactive /
+                 batch / best_effort, RetryBudget token bucket,
+                 per-class Retry-After backoffs
 
 Fault sites `serve.admit` / `serve.batch` / `serve.reload` /
-`fleet.dispatch` / `fleet.rollout` / `scale.decide` (utils.faults)
-make every degradation path deterministic on CPU.
+`fleet.dispatch` / `fleet.rollout` / `scale.decide` / `serve.hedge` /
+`engine.stall` (utils.faults) make every degradation path — hedged
+tail-cutting included — deterministic on CPU.
 """
 
+from . import qos
 from .autoscale import AutoScaler, AutoScaleSpec
-from .batcher import DeadlineExpired, MicroBatcher, Overloaded, Ticket
+from .batcher import (Cancelled, DeadlineExpired, MicroBatcher,
+                      Overloaded, Ticket)
 from .engine import InferenceEngine, ServeSpec
 from .fleet import (EngineFleet, FleetServer, RolloutController,
                     RolloutSpec)
@@ -61,15 +71,17 @@ from .router import (EngineUnavailable, HttpEngineHandle,
 from .scheduler import ContinuousScheduler, StreamTicket
 from .server import InferenceServer
 from .stats import ServeStats
+from .qos import PRIORITIES, ClassBackoffs, RetryBudget
 from .traffic import (Phase, TrafficGen, diurnal, flash_crowd, ramp,
-                      steady)
+                      stall_chaos, steady)
 
-__all__ = ["AutoScaler", "AutoScaleSpec", "ContinuousScheduler",
-           "DeadlineExpired", "EngineFleet", "EngineUnavailable",
-           "FleetServer", "HttpEngineHandle", "InferenceEngine",
-           "InferenceServer", "LocalEngineHandle", "MicroBatcher",
-           "Overloaded", "PagedKVCache", "Phase",
+__all__ = ["AutoScaler", "AutoScaleSpec", "Cancelled",
+           "ClassBackoffs", "ContinuousScheduler", "DeadlineExpired",
+           "EngineFleet", "EngineUnavailable", "FleetServer",
+           "HttpEngineHandle", "InferenceEngine", "InferenceServer",
+           "LocalEngineHandle", "MicroBatcher", "Overloaded",
+           "PRIORITIES", "PagedKVCache", "Phase", "RetryBudget",
            "RolloutController", "RolloutSpec", "Router", "RouterSpec",
            "RouterStats", "ServeSpec", "ServeStats", "StreamTicket",
-           "Ticket", "TrafficGen", "diurnal", "flash_crowd", "ramp",
-           "steady"]
+           "Ticket", "TrafficGen", "diurnal", "flash_crowd", "qos",
+           "ramp", "stall_chaos", "steady"]

@@ -57,6 +57,10 @@ class AutoScaleSpec:
     comma/semicolon-separated `key=value`."""
     slo_p95_ms: float = 200.0     # the latency budget
     max_shed_rate: float = 0.02   # tolerated windowed shed fraction
+    w_batch: float = 0.5          # batch-shed weight in the signal
+    w_best_effort: float = 0.0    # best_effort-shed weight (default:
+                                  # shedding best_effort is the plan,
+                                  # not a reason to buy capacity)
     min_engines: int = 1
     max_engines: int = 4
     cooldown_s: float = 5.0       # Backoff base between actions
@@ -91,6 +95,10 @@ class AutoScaleSpec:
         if int(self.quiet_ticks) < 1:
             raise ValueError(f"quiet_ticks must be >= 1, got "
                              f"{self.quiet_ticks}")
+        for name in ("w_batch", "w_best_effort"):
+            if not 0 <= float(getattr(self, name)) <= 1:
+                raise ValueError(f"{name} must be in [0, 1], got "
+                                 f"{getattr(self, name)}")
 
     @classmethod
     def parse(cls, spec: Optional[str]) -> "AutoScaleSpec":
@@ -181,7 +189,15 @@ class AutoScaler:
     def signals(self) -> Dict[str, Any]:
         """One coherent reading of every control input.  `n` counts
         ACTIVE members only — a draining engine is capacity already
-        spent, not capacity to reason about."""
+        spent, not capacity to reason about.
+
+        The shed signal is CLASS-WEIGHTED: an interactive shed counts
+        1.0, a batch shed `w_batch`, a best_effort shed
+        `w_best_effort` (default 0 — brownout shedding best_effort is
+        the system working, not a reason to buy capacity).  The raw
+        all-classes rate stays visible as `shed_rate_raw`.  p95 is the
+        INTERACTIVE class p95 when that class has completions — the
+        SLO is theirs; batch latency must not trigger scale-ups."""
         win = self.fleet.router.stats.windowed(self.spec.window_s)
         members = [m for m in self.fleet.router.members()
                    if not m.get("draining")]
@@ -206,15 +222,24 @@ class AutoScaler:
                     "lag_steps") or 0)
             except Exception:  # noqa: BLE001 — pipeline winding down
                 lag_steps = 0
+        by_class = win.get("shed_by_class") or {}
+        weighted = (by_class.get("interactive", 0) * 1.0
+                    + by_class.get("batch", 0)
+                    * float(self.spec.w_batch)
+                    + by_class.get("best_effort", 0)
+                    * float(self.spec.w_best_effort))
+        p95_cls = (win.get("p95_by_class") or {}).get("interactive")
         return {
             "n": len(members),
             "healthy": sum(1 for m in members
                            if m["healthy"] and not m["quarantined"]),
             "queue_depth": sum(m["queue_depth"] + m["in_flight"]
                                for m in members),
-            "shed_rate": win["shed_rate"],
+            "shed_rate": round(weighted / max(win["routed"], 1), 4),
+            "shed_rate_raw": win["shed_rate"],
             "qps": win["qps"],
-            "p95_ms": win["p95_latency_ms"],
+            "p95_ms": (p95_cls if p95_cls is not None
+                       else win["p95_latency_ms"]),
             "occupancy": occ,
             "lag_steps": lag_steps,
         }

@@ -39,6 +39,7 @@ import numpy as np
 
 from .. import obs
 from ..utils import faults
+from . import qos
 from .engine import InferenceEngine
 from .stats import ServeStats
 
@@ -52,7 +53,15 @@ class Overloaded(RuntimeError):
 
 
 class DeadlineExpired(RuntimeError):
-    """The request's deadline passed before it was dispatched."""
+    """The request's deadline passed before it was dispatched.  With
+    end-to-end propagation (serve/qos.py) this includes dead on
+    arrival: the remaining budget was already <= 0 at admission."""
+
+
+class Cancelled(RuntimeError):
+    """The caller cancelled the request (a hedge's losing attempt):
+    dropped from the queue / retired from its slot, counted
+    `cancelled` — never `failed`, never a strike."""
 
 
 class Ticket:
@@ -91,6 +100,8 @@ class _Request:
     ticket: Ticket
     t_submit: float
     deadline: Optional[float]     # monotonic, None = no deadline
+    priority: str = "interactive"
+    cancel_event: Optional[threading.Event] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
 
@@ -115,7 +126,13 @@ class MicroBatcher:
         # traceable flow (docs/OBSERVABILITY.md)
         self._req_ids = itertools.count(1)
         self._batch_ids = itertools.count(1)
-        self._sheds_in_a_row = 0
+        # per-class shed streaks/backoffs (honest per-class
+        # Retry-After; the interactive stream matches the old
+        # single-class behavior bit-for-bit)
+        self._class_backoffs = qos.ClassBackoffs(
+            base=getattr(self._backoff, "base", 0.05),
+            cap=getattr(self._backoff, "cap", 2.0),
+            seed=getattr(self._backoff, "seed", self.spec.seed))
         self._stop = False
         self._thread: Optional[threading.Thread] = None
 
@@ -148,12 +165,21 @@ class MicroBatcher:
 
     # -- admission ----------------------------------------------------------
     def submit(self, tokens, mode: str = "generate",
-               timeout: Optional[float] = None) -> Ticket:
+               timeout: Optional[float] = None,
+               deadline: Optional[float] = None,
+               priority: str = "interactive",
+               cancel_event: Optional[threading.Event] = None) -> Ticket:
         """Admit one request.  `tokens` is a 1-D int32 prompt;
-        `timeout` (seconds, default spec.request_timeout_s; <=0 = no
-        deadline) bounds time-in-queue.  Raises `Overloaded` (with
-        `retry_after`) when the queue is full or a `serve.admit` fault
-        fires; ValueError for an unservable prompt."""
+        `deadline` (absolute monotonic; wins over `timeout`, which
+        still derives one: spec.request_timeout_s default, <=0 = none)
+        bounds time-in-queue — a request dead on arrival is refused
+        before it queues (`expired_on_arrival`).  `priority`
+        (serve/qos.py classes) drives brownout: under queue pressure
+        lower classes shed first with an honest per-class Retry-After.
+        `cancel_event`, when set by the caller, drops the request at
+        the next gather (counted `cancelled`).  Raises `Overloaded`
+        (with `retry_after`) on shed; ValueError for an unservable
+        prompt or unknown priority."""
         arr = np.asarray(tokens, np.int32).reshape(-1)
         if arr.size < 1:
             self.stats.count("rejected")
@@ -169,43 +195,73 @@ class MicroBatcher:
         if mode not in ("generate", "predict"):
             self.stats.count("rejected")
             raise ValueError(f"unknown mode {mode!r}")
-        if timeout is None:
-            timeout = self.spec.request_timeout_s
+        try:
+            priority = qos.check_priority(priority)
+        except ValueError:
+            self.stats.count("rejected")
+            raise
+        deadline = qos.resolve_deadline(timeout, deadline,
+                                        self.spec.request_timeout_s)
         now = time.monotonic()
+        if deadline is not None and now >= deadline:
+            # dead on arrival: refuse before it queues — zero queue
+            # time, zero engine work burned on a client that gave up
+            self.stats.count("expired_on_arrival")
+            raise DeadlineExpired(
+                f"dead on arrival: deadline passed "
+                f"{now - deadline:.3f}s before admission")
         corr = f"req-{next(self._req_ids)}"
         req = _Request(tokens=arr, plen=int(arr.size), mode=mode,
                        ticket=Ticket(), t_submit=now,
-                       deadline=(now + timeout) if timeout > 0 else None,
+                       deadline=deadline, priority=priority,
+                       cancel_event=cancel_event,
                        extra={"corr": corr})
         with obs.span("batcher.admit", corr=corr, mode=mode,
-                      plen=int(arr.size)):
+                      plen=int(arr.size), priority=priority):
             try:
                 faults.maybe_fault("serve.admit")
             except faults.FaultError as e:
-                return self._shed(f"admission fault: {e}", corr=corr)
+                return self._shed(f"admission fault: {e}", corr=corr,
+                                  priority=priority)
             with self._cv:
                 if self._stop:
                     raise RuntimeError("batcher is stopped")
-                if len(self._q) >= self.spec.queue_capacity:
+                depth = len(self._q)
+                if depth >= self.spec.queue_capacity or \
+                        not self._brownout_admits(priority, depth):
                     pass  # shed outside the lock's happy path below
                 else:
                     self._q.append(req)
-                    self._sheds_in_a_row = 0
+                    self._class_backoffs.reset(priority)
                     self.stats.count("submitted")
                     self.stats.gauge("queue_depth", len(self._q))
                     self._cv.notify()
                     return req.ticket
-            return self._shed(
-                f"queue full ({self.spec.queue_capacity} requests)",
-                corr=corr)
+            if depth >= self.spec.queue_capacity:
+                why = f"queue full ({self.spec.queue_capacity} requests)"
+            else:
+                why = (f"brownout: queue {depth}/"
+                       f"{self.spec.queue_capacity} sheds {priority}")
+            return self._shed(why, corr=corr, priority=priority)
 
-    def _shed(self, why: str, corr: Optional[str] = None) -> "Ticket":
-        with self._cv:
-            self._sheds_in_a_row += 1
-            attempt = self._sheds_in_a_row
+    def _brownout_admits(self, priority: str, depth: int) -> bool:
+        """Class-aware admission under pressure: best_effort is shed
+        once the queue is `brownout_be_frac` full, batch at
+        `brownout_batch_frac`; interactive rides to the cap."""
+        if priority == "interactive":
+            return True
+        frac = (self.spec.brownout_be_frac
+                if priority == "best_effort"
+                else self.spec.brownout_batch_frac)
+        return depth < max(int(frac * self.spec.queue_capacity), 1)
+
+    def _shed(self, why: str, corr: Optional[str] = None,
+              priority: str = "interactive") -> "Ticket":
         self.stats.count("shed")
-        retry = self._backoff.delay(attempt - 1)
+        self.stats.count(f"shed_{priority}")
+        retry = self._class_backoffs.shed_delay(priority)
         obs.emit_event("serve.shed", why=why, corr=corr,
+                       priority=priority,
                        retry_after=round(retry, 4))
         raise Overloaded(f"request shed ({why}); retry after "
                          f"{retry:.3f}s", retry_after=retry)
@@ -245,6 +301,13 @@ class MicroBatcher:
             now = time.monotonic()
             while self._q and len(reqs) < spec.max_batch:
                 r = self._q.popleft()
+                if r.cancel_event is not None and \
+                        r.cancel_event.is_set():
+                    # hedge loser: dropped before any engine work
+                    self.stats.count("cancelled")
+                    r.ticket._fail(Cancelled(
+                        "cancelled by caller while queued"))
+                    continue
                 if r.deadline is not None and now > r.deadline:
                     self.stats.count("expired")
                     r.ticket._fail(DeadlineExpired(

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Dict, Optional, Tuple
 
@@ -77,6 +78,16 @@ class ServeSpec:
     reload_poll_s: float = 1.0
     degraded_after: int = 3   # consecutive failed batches -> degraded
     seed: int = 0
+    # engine.stall fault site: the host-side sleep the silent "stall"
+    # kind latches onto this engine's every compiled call — the
+    # deterministic straggler for the hedging bench
+    stall_fault_s: float = 0.25
+    # priority-aware brownout (serve/qos.py): under queue pressure
+    # admission sheds lowest class first.  best_effort is shed once the
+    # queue is `brownout_be_frac` full, batch at `brownout_batch_frac`;
+    # interactive sheds only when the queue is actually full
+    brownout_be_frac: float = 0.5
+    brownout_batch_frac: float = 0.75
     # continuous batching (serve/scheduler.py): cb=on replaces the
     # static generate buckets with a paged-KV slot scheduler.  The
     # compiled geometry is (cb_slots, blocks-per-slot, cb_block_len,
@@ -119,6 +130,15 @@ class ServeSpec:
         if int(self.cb_blocks) < 0 or int(self.cb_prompt_cap) < 0:
             raise ValueError("cb_blocks and cb_prompt_cap must be "
                              ">= 0 (0 = auto)")
+        if float(self.stall_fault_s) < 0:
+            raise ValueError(f"stall_fault_s must be >= 0, got "
+                             f"{self.stall_fault_s}")
+        be, ba = (float(self.brownout_be_frac),
+                  float(self.brownout_batch_frac))
+        if not (0 < be <= ba <= 1):
+            raise ValueError(
+                f"brownout fractions must satisfy 0 < be_frac <= "
+                f"batch_frac <= 1, got be={be} batch={ba}")
 
     @property
     def max_prompt_len(self) -> int:
@@ -284,6 +304,11 @@ class InferenceEngine:
         self._compile_lock = threading.Lock()
         self._key_counter = 0
         self._key_lock = threading.Lock()
+        # injected straggler latency (engine.stall / set_stall): a
+        # host-side sleep before every compiled call.  The engine stays
+        # healthy — probes pass, requests complete — it is just SLOW,
+        # which is exactly the failure mode hedging exists for.
+        self.stall_s = 0.0
 
     # -- params lifecycle ---------------------------------------------------
     @property
@@ -682,6 +707,7 @@ class InferenceEngine:
         `row` the first P//block_len entries of the slot's block
         table.  Returns (first sampled token (int), new pools) —
         `pools` was donated; callers must use the returned tree."""
+        self._maybe_stall()
         compiled = self._compile_cb("prefill")
         tok0, pools = compiled(params, pools,
                                jnp.asarray(tokens, jnp.int32),
@@ -694,6 +720,7 @@ class InferenceEngine:
                       ntoks: np.ndarray, tables: np.ndarray):
         """One decode step for all S slots.  Returns ((S,) int32 next
         tokens on host, new pools).  `pools` was donated."""
+        self._maybe_stall()
         compiled = self._compile_cb("decode")
         nxt, pools = compiled(params, pools,
                               jnp.asarray(tokens, jnp.int32),
@@ -755,6 +782,21 @@ class InferenceEngine:
         return self.stats.compiles - before
 
     # -- execution ----------------------------------------------------------
+    def set_stall(self, seconds: float) -> None:
+        """Latch `seconds` of host-side sleep onto every compiled call
+        (0 clears it).  Benches/tests use this for deterministic
+        per-engine targeting; the `engine.stall` fault site latches
+        `spec.stall_fault_s` on whichever engine's thread it fires in."""
+        self.stall_s = max(float(seconds), 0.0)
+
+    def _maybe_stall(self) -> None:
+        kind = faults.maybe_fault("engine.stall")
+        if kind == "stall":
+            self.stall_s = max(self.stall_s,
+                               float(self.spec.stall_fault_s))
+        if self.stall_s > 0:
+            time.sleep(self.stall_s)
+
     def _next_key(self) -> np.ndarray:
         # raw threefry key data, built host-side: no jax dispatch (and
         # no trace) on the per-batch path
@@ -779,6 +821,7 @@ class InferenceEngine:
         # on the dispatch thread this nests under batcher.dispatch and
         # inherits its batch-M correlation id
         with obs.span("engine.run_batch", mode=mode, batch=b, plen=p):
+            self._maybe_stall()
             compiled = self._compile(mode, b, p)
             tokens = jnp.asarray(tokens, jnp.int32)
             plens = jnp.asarray(plens, jnp.int32)

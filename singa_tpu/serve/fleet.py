@@ -635,19 +635,26 @@ class EngineFleet:
         return drained
 
     # -- client API ---------------------------------------------------------
-    def generate(self, tokens, timeout=None) -> Dict[str, Any]:
-        return self.router.route("generate", tokens, timeout=timeout)
+    def generate(self, tokens, timeout=None, deadline=None,
+                 priority="interactive") -> Dict[str, Any]:
+        return self.router.route("generate", tokens, timeout=timeout,
+                                 deadline=deadline, priority=priority)
 
-    def generate_stream(self, tokens, timeout=None, max_new=None):
+    def generate_stream(self, tokens, timeout=None, max_new=None,
+                        deadline=None, priority="interactive"):
         """Streaming generate through the fleet (cb members only):
         yields {"token": t} events then the {"done": True, ...}
         summary; retries on another engine only before the first
         event (Router.route_stream)."""
         return self.router.route_stream(tokens, timeout=timeout,
-                                        max_new=max_new)
+                                        max_new=max_new,
+                                        deadline=deadline,
+                                        priority=priority)
 
-    def predict(self, tokens, timeout=None) -> Dict[str, Any]:
-        return self.router.route("predict", tokens, timeout=timeout)
+    def predict(self, tokens, timeout=None, deadline=None,
+                priority="interactive") -> Dict[str, Any]:
+        return self.router.route("predict", tokens, timeout=timeout,
+                                 deadline=deadline, priority=priority)
 
     def snapshot(self) -> Dict[str, Any]:
         out = self.router.snapshot()
@@ -683,6 +690,7 @@ class FleetServer:
 
         import numpy as np
 
+        from . import qos as _qos
         from .batcher import DeadlineExpired as _DE
         from .batcher import Overloaded as _OL
 
@@ -745,7 +753,12 @@ class FleetServer:
                 mn = req.get("max_new")
                 stream = fleet.router.route_stream(
                     tokens, timeout=req.get("timeout"),
-                    max_new=None if mn is None else int(mn))
+                    max_new=None if mn is None else int(mn),
+                    deadline=_qos.deadline_from_header(
+                        self.headers.get(_qos.DEADLINE_HEADER)),
+                    priority=_qos.check_priority(
+                        req.get("priority")
+                        or self.headers.get(_qos.PRIORITY_HEADER)))
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "application/x-ndjson")
@@ -774,9 +787,13 @@ class FleetServer:
                     if mode == "generate" and req.get("stream"):
                         self._stream(tokens, req)
                         return
-                    out = fleet.router.route(mode, tokens,
-                                             timeout=req.get(
-                                                 "timeout"))
+                    out = fleet.router.route(
+                        mode, tokens, timeout=req.get("timeout"),
+                        deadline=_qos.deadline_from_header(
+                            self.headers.get(_qos.DEADLINE_HEADER)),
+                        priority=_qos.check_priority(
+                            req.get("priority")
+                            or self.headers.get(_qos.PRIORITY_HEADER)))
                     self._reply(200, out)
                 except _OL as e:
                     self._reply(503, {"error": str(e),

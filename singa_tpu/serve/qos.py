@@ -1,0 +1,171 @@
+"""Request-lifecycle QoS primitives shared across the serving stack:
+priority classes, end-to-end deadlines, the global retry budget, and
+per-class shed backoffs.
+
+Deadlines ("RPC Considered Harmful", arxiv 1805.08430): a client
+timeout re-invented at every hop lets a request burn the full budget
+per hop — four 5s hops serve a client who gave up 15s ago.  Here the
+deadline is ONE absolute instant carried on the request: in-process as
+a `time.monotonic()` value, across HTTP as the *remaining* budget in
+milliseconds (`X-Deadline-Ms` — monotonic clocks are not comparable
+across processes, so the receiver re-anchors remaining-ms onto its own
+clock, the gRPC convention).  Every hop admits against what is LEFT;
+an engine never prefills a request that is already dead on arrival
+(counted `expired_on_arrival`), and a router retry can never outlive
+the client's deadline.
+
+Priority classes: `interactive` (a user is watching), `batch`
+(pipelines; minutes of slack), `best_effort` (scavenger load).  Under
+pressure admission sheds lowest class first — brownout — with an
+honest per-class Retry-After: lower classes start (and cap) higher, so
+the backoff hints themselves push background load out of the way of
+interactive traffic.
+
+Retry budget ("The Tail at Scale"): unbounded per-request retries turn
+a brownout into a retry storm exactly when capacity is lowest.  The
+`RetryBudget` token bucket earns a fraction of a token per PRIMARY
+dispatch and spends one per retry or hedge, so fleet-wide retry
+amplification is arithmetically capped at (1 + ratio) regardless of
+failure pattern.  Exhaustion degrades to single-shot dispatch — the
+request's first outcome stands; it is never shed *because* the budget
+ran dry.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..utils import faults
+
+PRIORITIES = ("interactive", "batch", "best_effort")
+
+#: HTTP header carrying the remaining deadline budget in milliseconds
+#: (re-anchored onto the receiver's monotonic clock)
+DEADLINE_HEADER = "X-Deadline-Ms"
+PRIORITY_HEADER = "X-Priority"
+
+#: Retry-After escalation factor per class: lower classes are told to
+#: stay away longer, so honest hints do the brownout's first pass
+_CLASS_FACTORS = (("interactive", 1.0), ("batch", 2.0),
+                  ("best_effort", 4.0))
+
+
+def check_priority(priority: Optional[str]) -> str:
+    """Normalize and validate a priority class (None = interactive).
+    Raises ValueError (the HTTP layer's 400) on an unknown class."""
+    if priority is None:
+        return "interactive"
+    p = str(priority).strip().lower()
+    if p not in PRIORITIES:
+        raise ValueError(f"unknown priority {priority!r}; classes are "
+                         f"{PRIORITIES}")
+    return p
+
+
+def resolve_deadline(timeout: Optional[float],
+                     deadline: Optional[float],
+                     default_timeout_s: float) -> Optional[float]:
+    """The request's ONE absolute monotonic deadline: an explicit
+    `deadline` wins; otherwise derived from `timeout` (default
+    `default_timeout_s`; <= 0 = no deadline)."""
+    if deadline is not None:
+        return float(deadline)
+    t = default_timeout_s if timeout is None else float(timeout)
+    return (time.monotonic() + t) if t and t > 0 else None
+
+
+def remaining_s(deadline: Optional[float]) -> Optional[float]:
+    """Seconds of budget left (may be <= 0: dead on arrival)."""
+    if deadline is None:
+        return None
+    return deadline - time.monotonic()
+
+
+def deadline_to_header(deadline: Optional[float]) -> Optional[str]:
+    """Remaining-budget milliseconds for `X-Deadline-Ms` (floored at 0
+    so a dead request still propagates as dead, not as no-deadline)."""
+    rem = remaining_s(deadline)
+    if rem is None:
+        return None
+    return str(max(int(rem * 1000), 0))
+
+
+def deadline_from_header(value: Optional[str]) -> Optional[float]:
+    """Re-anchor a remaining-ms header onto THIS process's monotonic
+    clock (monotonic instants are not comparable across processes)."""
+    if value is None or str(value).strip() == "":
+        return None
+    return time.monotonic() + float(value) / 1000.0
+
+
+class RetryBudget:
+    """Global token bucket bounding retries + hedges to a fraction of
+    primary traffic.  `earn()` once per primary dispatch adds `ratio`
+    tokens (capped at `burst`); `spend()` takes one whole token per
+    retry/hedge or answers False.  With ratio r, total dispatches can
+    never exceed (1 + r) x primaries + burst — a retry storm is
+    arithmetically impossible, not merely discouraged."""
+
+    def __init__(self, ratio: float = 0.1, burst: float = 16.0):
+        self.ratio = max(float(ratio), 0.0)
+        self.burst = max(float(burst), 0.0)
+        self._tokens = self.burst
+        self._lock = threading.Lock()
+
+    def earn(self, n: int = 1) -> None:
+        with self._lock:
+            self._tokens = min(self.burst,
+                               self._tokens + self.ratio * n)
+
+    def spend(self, n: float = 1.0) -> bool:
+        with self._lock:
+            if self._tokens < n:
+                return False
+            self._tokens -= n
+            return True
+
+    def refund(self, n: float = 1.0) -> None:
+        """Return a token whose dispatch never happened (no sibling
+        engine, hedge fault) — spend/refund stays conservative."""
+        with self._lock:
+            self._tokens = min(self.burst, self._tokens + n)
+
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+
+class ClassBackoffs:
+    """Per-priority-class shed Retry-After: each class escalates over
+    ITS consecutive sheds and resets on ITS next successful admission,
+    with lower classes starting (and capping) `_CLASS_FACTORS` higher.
+    The interactive stream reproduces the single-class Backoff the
+    admission paths used before priorities existed."""
+
+    def __init__(self, base: float = 0.05, cap: float = 2.0,
+                 seed: int = 0):
+        self._lock = threading.Lock()
+        self._backoffs = {}
+        self._streaks = {}
+        for i, (pri, factor) in enumerate(_CLASS_FACTORS):
+            self._backoffs[pri] = faults.Backoff(
+                base=base * factor, cap=cap * factor, seed=seed + i)
+            self._streaks[pri] = 0
+
+    def shed_delay(self, priority: str) -> float:
+        """Record one shed of `priority`; the Retry-After to hint."""
+        with self._lock:
+            self._streaks[priority] += 1
+            attempt = self._streaks[priority]
+        return self._backoffs[priority].delay(attempt - 1)
+
+    def reset(self, priority: str) -> None:
+        """A successful admission of `priority` ends its streak."""
+        with self._lock:
+            self._streaks[priority] = 0
+
+    def streak(self, priority: str) -> int:
+        with self._lock:
+            return self._streaks[priority]

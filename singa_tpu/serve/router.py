@@ -45,7 +45,9 @@ right now" and "move this request somewhere else".
 from __future__ import annotations
 
 import dataclasses
+import inspect
 import json
+import queue
 import threading
 import time
 import urllib.error
@@ -58,7 +60,8 @@ import numpy as np
 
 from .. import obs
 from ..utils import faults
-from .batcher import DeadlineExpired, Overloaded
+from . import qos
+from .batcher import Cancelled, DeadlineExpired, Overloaded
 
 
 class EngineUnavailable(RuntimeError):
@@ -78,6 +81,13 @@ class RouterSpec:
     max_attempts: int = 0          # engines tried per request (0 = all)
     request_timeout_s: float = 5.0
     seed: int = 0
+    hedge: str = "on"              # hedged dispatch ("Tail at Scale")
+    hedge_min_s: float = 0.05      # clamp on the p95-derived delay
+    hedge_max_s: float = 1.0
+    retry_budget_ratio: float = 0.1   # retries+hedges per primary
+    retry_budget_burst: float = 16.0  # token-bucket cap
+    brownout_shed_rate: float = 0.1   # capacity-shed rate engaging
+                                      # brownout (0 = never)
 
     def __post_init__(self):
         if int(self.quarantine_after) < 1:
@@ -86,6 +96,15 @@ class RouterSpec:
         if float(self.probe_period_s) <= 0:
             raise ValueError(f"probe_period_s must be > 0, got "
                              f"{self.probe_period_s}")
+        if str(self.hedge) not in ("on", "off"):
+            raise ValueError(f"hedge must be on|off, got {self.hedge!r}")
+        if not (0 < float(self.hedge_min_s) <= float(self.hedge_max_s)):
+            raise ValueError(
+                f"need 0 < hedge_min_s <= hedge_max_s, got "
+                f"{self.hedge_min_s}/{self.hedge_max_s}")
+        if float(self.retry_budget_ratio) < 0 or \
+                float(self.retry_budget_burst) < 0:
+            raise ValueError("retry budget ratio/burst must be >= 0")
 
     @classmethod
     def parse(cls, spec: Optional[str]) -> "RouterSpec":
@@ -100,12 +119,44 @@ class RouterSpec:
                 key, val = key.strip(), val.strip()
                 if not sep or key not in types:
                     raise ValueError(f"unknown key {key!r}")
-                kw[key] = (float(val) if "float" in str(types[key])
-                           else int(val))
+                if "str" in str(types[key]):
+                    kw[key] = val.lower()
+                else:
+                    kw[key] = (float(val)
+                               if "float" in str(types[key])
+                               else int(val))
             except ValueError as e:
                 raise ValueError(f"bad fleet spec entry {part!r} "
                                  f"(want key=value): {e}") from e
         return cls(**kw)
+
+
+# signature cache for duck-typed handles: tests (and future adapters)
+# plug in handles whose request() predates deadlines/priorities — the
+# router forwards only the keywords each handle actually accepts
+_SIG_CACHE: Dict[Any, Optional[frozenset]] = {}
+
+
+def _accepted_kwargs(fn) -> Optional[frozenset]:
+    key = getattr(fn, "__func__", fn)
+    if key not in _SIG_CACHE:
+        try:
+            params = inspect.signature(key).parameters
+            if any(p.kind == inspect.Parameter.VAR_KEYWORD
+                   for p in params.values()):
+                _SIG_CACHE[key] = None       # **kwargs: takes anything
+            else:
+                _SIG_CACHE[key] = frozenset(params)
+        except (TypeError, ValueError):
+            _SIG_CACHE[key] = None
+    return _SIG_CACHE[key]
+
+
+def _handle_call(fn, args: tuple, kwargs: Dict[str, Any]):
+    accepted = _accepted_kwargs(fn)
+    if accepted is not None:
+        kwargs = {k: v for k, v in kwargs.items() if k in accepted}
+    return fn(*args, **kwargs)
 
 
 # -- engine handles ---------------------------------------------------------
@@ -151,21 +202,30 @@ class LocalEngineHandle:
         return self.server.snapshot()
 
     def request(self, mode: str, tokens,
-                timeout: Optional[float] = None) -> Dict[str, Any]:
+                timeout: Optional[float] = None,
+                deadline: Optional[float] = None,
+                priority: str = "interactive",
+                cancel_event: Optional[threading.Event] = None
+                ) -> Dict[str, Any]:
         if not self._alive:
             raise EngineUnavailable(f"engine {self.name} is down")
         call = (self.server.generate if mode == "generate"
                 else self.server.predict)
         try:
-            return call(tokens, timeout=timeout)
-        except (Overloaded, DeadlineExpired, TimeoutError, ValueError):
+            return call(tokens, timeout=timeout, deadline=deadline,
+                        priority=priority, cancel_event=cancel_event)
+        except (Overloaded, DeadlineExpired, TimeoutError, ValueError,
+                Cancelled):
             raise
         except Exception as e:  # noqa: BLE001 — batch failed / stopped
             raise EngineUnavailable(
                 f"engine {self.name} failed: {e}") from e
 
     def request_stream(self, tokens, timeout: Optional[float] = None,
-                       max_new: Optional[int] = None):
+                       max_new: Optional[int] = None,
+                       deadline: Optional[float] = None,
+                       priority: str = "interactive",
+                       cancel_event: Optional[threading.Event] = None):
         """Streaming generate (cb engines only).  Admission happens
         HERE, before any event is yielded — the router's commit point
         for retry-on-other-engine.  Returns an iterator of ndjson-
@@ -174,16 +234,21 @@ class LocalEngineHandle:
         if not self._alive:
             raise EngineUnavailable(f"engine {self.name} is down")
         try:
-            ticket = self.server.generate_stream(tokens,
-                                                 timeout=timeout,
-                                                 max_new=max_new)
-        except (Overloaded, DeadlineExpired, TimeoutError, ValueError):
+            ticket = self.server.generate_stream(
+                tokens, timeout=timeout, max_new=max_new,
+                deadline=deadline, priority=priority,
+                cancel_event=cancel_event)
+        except (Overloaded, DeadlineExpired, TimeoutError, ValueError,
+                Cancelled):
             raise
         except Exception as e:  # noqa: BLE001 — no cb / stopped
             raise EngineUnavailable(
                 f"engine {self.name} cannot stream: {e}") from e
-        budget = (timeout if timeout and timeout > 0
-                  else self.engine.spec.request_timeout_s) + 30.0
+        rem = qos.remaining_s(deadline)
+        budget = max(rem if rem is not None
+                     else timeout if timeout and timeout > 0
+                     else self.engine.spec.request_timeout_s,
+                     0.1) + 30.0
 
         def gen():
             for kind, payload in ticket.events(timeout=budget):
@@ -215,12 +280,16 @@ class HttpEngineHandle:
 
     def _call(self, method: str, path: str,
               payload: Optional[dict] = None,
-              timeout: Optional[float] = None) -> Dict[str, Any]:
+              timeout: Optional[float] = None,
+              headers: Optional[Dict[str, str]] = None
+              ) -> Dict[str, Any]:
         data = (json.dumps(payload).encode()
                 if payload is not None else None)
+        hdrs = {"Content-Type": "application/json"}
+        hdrs.update(headers or {})
         req = urllib.request.Request(
             f"{self.base_url}{path}", data=data, method=method,
-            headers={"Content-Type": "application/json"})
+            headers=hdrs)
         try:
             with urllib.request.urlopen(
                     req, timeout=timeout or self.connect_timeout_s) as r:
@@ -260,18 +329,39 @@ class HttpEngineHandle:
     def stats_snapshot(self) -> Dict[str, Any]:
         return self._call("GET", "/stats")
 
+    @staticmethod
+    def _qos_headers(deadline: Optional[float],
+                     priority: Optional[str]) -> Dict[str, str]:
+        """End-to-end propagation over the wire: remaining-ms deadline
+        header (re-anchored by the receiver) + priority class."""
+        hdrs: Dict[str, str] = {}
+        dl = qos.deadline_to_header(deadline)
+        if dl is not None:
+            hdrs[qos.DEADLINE_HEADER] = dl
+        if priority is not None:
+            hdrs[qos.PRIORITY_HEADER] = str(priority)
+        return hdrs
+
     def request(self, mode: str, tokens,
-                timeout: Optional[float] = None) -> Dict[str, Any]:
+                timeout: Optional[float] = None,
+                deadline: Optional[float] = None,
+                priority: Optional[str] = None) -> Dict[str, Any]:
         toks = (tokens.tolist() if isinstance(tokens, np.ndarray)
                 else list(tokens))
         payload = {"tokens": [int(t) for t in toks]}
         if timeout is not None:
             payload["timeout"] = timeout
-        budget = (timeout or self.connect_timeout_s) + 30.0
-        return self._call("POST", f"/{mode}", payload, timeout=budget)
+        rem = qos.remaining_s(deadline)
+        budget = max(rem if rem is not None
+                     else timeout or self.connect_timeout_s,
+                     0.1) + 30.0
+        return self._call("POST", f"/{mode}", payload, timeout=budget,
+                          headers=self._qos_headers(deadline, priority))
 
     def request_stream(self, tokens, timeout: Optional[float] = None,
-                       max_new: Optional[int] = None):
+                       max_new: Optional[int] = None,
+                       deadline: Optional[float] = None,
+                       priority: Optional[str] = None):
         """Streaming generate over HTTP: POST {"stream": true} and
         decode the chunked ndjson line-by-line WITHOUT buffering the
         body.  The response status is the commit point: admission
@@ -286,11 +376,16 @@ class HttpEngineHandle:
             payload["timeout"] = timeout
         if max_new is not None:
             payload["max_new"] = int(max_new)
-        budget = (timeout or self.connect_timeout_s) + 30.0
+        rem = qos.remaining_s(deadline)
+        budget = max(rem if rem is not None
+                     else timeout or self.connect_timeout_s,
+                     0.1) + 30.0
+        hdrs = {"Content-Type": "application/json"}
+        hdrs.update(self._qos_headers(deadline, priority))
         req = urllib.request.Request(
             f"{self.base_url}/generate",
             data=json.dumps(payload).encode(), method="POST",
-            headers={"Content-Type": "application/json"})
+            headers=hdrs)
         try:
             resp = urllib.request.urlopen(req, timeout=budget)
         except urllib.error.HTTPError as e:
@@ -367,7 +462,10 @@ class RouterStats:
     "shedding right now"; the windowed view can."""
 
     FIELDS = ("routed", "completed", "retried", "failed", "shed",
-              "quarantines", "readmissions", "joins", "retires")
+              "quarantines", "readmissions", "joins", "retires",
+              "attempts", "hedges", "hedge_wins", "deadline_terminal",
+              "expired_on_arrival", "budget_denied", "brownout_sheds",
+              "shed_interactive", "shed_batch", "shed_best_effort")
 
     def __init__(self, window_s: float = 30.0):
         self.window_s = float(window_s)
@@ -377,8 +475,10 @@ class RouterStats:
         self._latencies: List[float] = []
         self._t0 = time.monotonic()
         self._routed_t: deque = deque(maxlen=16384)   # arrival stamps
-        self._shed_t: deque = deque(maxlen=16384)
-        self._done_t: deque = deque(maxlen=16384)     # (stamp, latency)
+        self._shed_t: deque = deque(maxlen=16384)     # (stamp, priority,
+                                                      #  brownout)
+        self._done_t: deque = deque(maxlen=16384)     # (stamp, latency,
+                                                      #  priority)
 
     def count(self, fieldname: str, n: int = 1) -> None:
         now = time.monotonic()
@@ -387,14 +487,32 @@ class RouterStats:
             if fieldname == "routed":
                 self._routed_t.extend([now] * n)
             elif fieldname == "shed":
-                self._shed_t.extend([now] * n)
+                self._shed_t.extend(
+                    [(now, "interactive", False)] * n)
 
-    def observe_latency(self, seconds: float) -> None:
+    def observe_shed(self, priority: str = "interactive",
+                     brownout: bool = False, n: int = 1) -> None:
+        """One shed, attributed to its class.  `brownout=False` is a
+        CAPACITY shed (nothing could take the request) — the pressure
+        signal that engages brownout; brownout sheds themselves are
+        excluded from it, or shedding would keep brownout engaged
+        forever (positive feedback)."""
+        now = time.monotonic()
+        with self._lock:
+            self.shed += n
+            setattr(self, f"shed_{priority}",
+                    getattr(self, f"shed_{priority}") + n)
+            if brownout:
+                self.brownout_sheds += n
+            self._shed_t.extend([(now, priority, brownout)] * n)
+
+    def observe_latency(self, seconds: float,
+                        priority: str = "interactive") -> None:
         with self._lock:
             self._latencies.append(seconds)
             if len(self._latencies) > 4096:
                 del self._latencies[:2048]
-            self._done_t.append((time.monotonic(), seconds))
+            self._done_t.append((time.monotonic(), seconds, priority))
 
     def windowed(self, window_s: Optional[float] = None) -> Dict[str, Any]:
         """Rates over the trailing window (capped at uptime so a
@@ -406,14 +524,27 @@ class RouterStats:
             window = min(window, max(now - self._t0, 1e-6))
             cut = now - window
             routed = sum(1 for t in self._routed_t if t >= cut)
-            shed = sum(1 for t in self._shed_t if t >= cut)
-            lats = sorted(l for t, l in self._done_t if t >= cut)
+            sheds = [(p, b) for t, p, b in self._shed_t if t >= cut]
+            done = [(l, p) for t, l, p in self._done_t if t >= cut]
+        lats = sorted(l for l, _ in done)
+        shed = len(sheds)
+        capacity_shed = sum(1 for _, b in sheds if not b)
 
-        def q(frac):
-            if not lats:
+        def q(frac, xs=None):
+            xs = lats if xs is None else xs
+            if not xs:
                 return None
             return round(
-                lats[min(int(frac * len(lats)), len(lats) - 1)] * 1e3, 3)
+                xs[min(int(frac * len(xs)), len(xs) - 1)] * 1e3, 3)
+        shed_by_class = {p: 0 for p in qos.PRIORITIES}
+        for p, _ in sheds:
+            shed_by_class[p] = shed_by_class.get(p, 0) + 1
+        completed_by_class = {p: 0 for p in qos.PRIORITIES}
+        p95_by_class: Dict[str, Optional[float]] = {}
+        for pri in qos.PRIORITIES:
+            cls = sorted(l for l, p in done if p == pri)
+            completed_by_class[pri] = len(cls)
+            p95_by_class[pri] = q(0.95, cls)
         return {
             "window_s": round(window, 3),
             "routed": routed,
@@ -421,8 +552,14 @@ class RouterStats:
             "completed": len(lats),
             "qps": round(len(lats) / window, 3),
             "shed_rate": round(shed / max(routed, 1), 4),
+            "capacity_shed_rate": round(
+                capacity_shed / max(routed, 1), 4),
             "p50_latency_ms": q(0.5),
             "p95_latency_ms": q(0.95),
+            "p99_latency_ms": q(0.99),
+            "shed_by_class": shed_by_class,
+            "completed_by_class": completed_by_class,
+            "p95_by_class": p95_by_class,
         }
 
     def latency_quantile(self, q: float) -> Optional[float]:
@@ -435,16 +572,20 @@ class RouterStats:
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             out = {f: getattr(self, f) for f in self.FIELDS}
-        p50, p95 = (self.latency_quantile(0.5),
-                    self.latency_quantile(0.95))
+        p50, p95, p99 = (self.latency_quantile(0.5),
+                         self.latency_quantile(0.95),
+                         self.latency_quantile(0.99))
         out["p50_latency_ms"] = (round(p50 * 1e3, 3)
                                  if p50 is not None else None)
         out["p95_latency_ms"] = (round(p95 * 1e3, 3)
                                  if p95 is not None else None)
+        out["p99_latency_ms"] = (round(p99 * 1e3, 3)
+                                 if p99 is not None else None)
         win = self.windowed()
         out["qps_recent"] = win["qps"]
         out["shed_rate_recent"] = win["shed_rate"]
         out["p95_latency_recent_ms"] = win["p95_latency_ms"]
+        out["p99_latency_recent_ms"] = win["p99_latency_ms"]
         return out
 
     def register_into(self, registry,
@@ -459,8 +600,10 @@ class RouterStats:
             out += [Sample(f"{prefix}_{k}", "gauge",
                            f"fleet router gauge {k!r}", float(snap[k]))
                     for k in ("p50_latency_ms", "p95_latency_ms",
-                              "qps_recent", "shed_rate_recent",
-                              "p95_latency_recent_ms")
+                              "p99_latency_ms", "qps_recent",
+                              "shed_rate_recent",
+                              "p95_latency_recent_ms",
+                              "p99_latency_recent_ms")
                     if snap.get(k) is not None]
             return out
 
@@ -488,9 +631,20 @@ class Router:
         self._backoff = faults.Backoff(base=self.spec.readmit_base_s,
                                        cap=self.spec.readmit_cap_s,
                                        seed=self.spec.seed)
-        self._shed_backoff = faults.Backoff(base=0.05, cap=2.0,
-                                            seed=self.spec.seed + 1)
-        self._sheds_in_a_row = 0
+        # per-class shed Retry-After (the old single-class backoff is
+        # the interactive stream)
+        self._shed_backoffs = qos.ClassBackoffs(base=0.05, cap=2.0,
+                                                seed=self.spec.seed + 1)
+        # global retry budget: retries AND hedges draw from it
+        self.retry_budget = qos.RetryBudget(
+            ratio=self.spec.retry_budget_ratio,
+            burst=self.spec.retry_budget_burst)
+        # cached control signals (recomputed at most every 0.5s: the
+        # deques behind windowed() are too big for the hot path)
+        self._hedge_cache: float = float(self.spec.hedge_max_s)
+        self._hedge_cache_t: float = 0.0
+        self._pressure: float = 0.0
+        self._pressure_t: float = 0.0
         self._probe_stop = threading.Event()
         self._probe_thread: Optional[threading.Thread] = None
 
@@ -687,139 +841,506 @@ class Router:
             if m is not None:
                 m.in_flight -= 1
 
+    # -- hedging / brownout control signals ---------------------------------
+    def _hedge_delay(self) -> float:
+        """When to launch the hedge: the windowed p95 latency ("Tail
+        at Scale" — hedge only the slowest ~5%), clamped to
+        [hedge_min_s, hedge_max_s]; hedge_max_s while there is no
+        latency history yet.  Cached ~0.5s."""
+        now = time.monotonic()
+        if now - self._hedge_cache_t < 0.5:
+            return self._hedge_cache
+        p95 = self.stats.windowed()["p95_latency_ms"]
+        d = (float(self.spec.hedge_max_s) if p95 is None
+             else p95 / 1e3)
+        d = min(max(d, float(self.spec.hedge_min_s)),
+                float(self.spec.hedge_max_s))
+        self._hedge_cache, self._hedge_cache_t = d, now
+        return d
+
+    def _brownout_sheds(self, priority: str) -> bool:
+        """Router-level brownout: when the recent CAPACITY-shed rate
+        (sheds where nothing could take the request — brownout's own
+        sheds excluded, see RouterStats.observe_shed) crosses
+        `brownout_shed_rate`, stop admitting best_effort; at 3x the
+        threshold, batch too.  Interactive always passes."""
+        if priority == "interactive" or \
+                float(self.spec.brownout_shed_rate) <= 0:
+            return False
+        now = time.monotonic()
+        if now - self._pressure_t > 0.5:
+            win = self.stats.windowed(5.0)
+            self._pressure = float(win["capacity_shed_rate"])
+            self._pressure_t = now
+        thr = float(self.spec.brownout_shed_rate)
+        if priority == "best_effort":
+            return self._pressure >= thr
+        return self._pressure >= 3 * thr
+
+    def _call_handle(self, name: str, mode: str, tokens,
+                     timeout, deadline, priority,
+                     cancel_event) -> Dict[str, Any]:
+        """One engine call, forwarding only the QoS keywords the
+        handle's `request` signature accepts (duck-typed handles
+        predate deadlines/priorities)."""
+        with self._lock:
+            m = self._members.get(name)
+        if m is None:
+            raise EngineUnavailable(f"engine {name} retired "
+                                    f"mid-dispatch")
+        return _handle_call(
+            m.handle.request, (mode, tokens),
+            {"timeout": timeout, "deadline": deadline,
+             "priority": priority, "cancel_event": cancel_event})
+
+    def _try_hedge(self, exclude: set, cancels: Dict[str, Any],
+                   launch, deadline) -> Optional[str]:
+        """Launch the hedged attempt if the budget, the fleet, and the
+        deadline allow.  A `serve.hedge` fault abandons the hedge only
+        — the primary is untouched.  Returns the hedge engine's name,
+        or None (with the spent token refunded when no dispatch
+        happened)."""
+        rem = qos.remaining_s(deadline)
+        if rem is not None and rem <= 0:
+            return None               # a hedge would be dead on arrival
+        if not self.retry_budget.spend():
+            self.stats.count("budget_denied")
+            return None               # degrade to single-shot, not shed
+        name = self._pick(exclude)
+        if name is None:
+            self.retry_budget.refund()
+            return None
+        try:
+            faults.maybe_fault("serve.hedge")
+        except faults.FaultError as e:
+            self._release(name)
+            self.retry_budget.refund()
+            obs.emit_event("serve.hedge_abandoned", engine=name,
+                           why=str(e))
+            return None
+        self.stats.count("hedges")
+        cancels[name] = threading.Event()
+        launch(name, None)
+        return name
+
+    def _hedged_request(self, name: str, mode: str, tokens,
+                        timeout, deadline, priority) -> tuple:
+        """Dispatch to `name`, hedging onto a sibling once the
+        p95-derived delay elapses without a result; first result wins
+        and the loser is cancelled.  Owns releasing every in-flight
+        slot it holds (the caller's `_pick` took `name`'s).  Returns
+        (winner, out) or raises the decisive exception — the
+        primary's, unless only the hedge answered."""
+        resq: "queue.Queue" = queue.Queue()
+        cancels: Dict[str, threading.Event] = {name: threading.Event()}
+
+        def run(engine_name: str, site: Optional[str]) -> None:
+            self.stats.count("attempts")
+            try:
+                if site is not None:
+                    faults.maybe_fault(site)
+                out = self._call_handle(
+                    name=engine_name, mode=mode, tokens=tokens,
+                    timeout=timeout, deadline=deadline,
+                    priority=priority,
+                    cancel_event=cancels[engine_name])
+                resq.put((engine_name, "ok", out))
+            except (Overloaded, DeadlineExpired, TimeoutError,
+                    ValueError, Cancelled) as e:
+                resq.put((engine_name, "err", e))
+            except BaseException as e:  # noqa: BLE001 — engine failure
+                with self._lock:
+                    mm = self._members.get(engine_name)
+                    if mm is not None:
+                        mm.failed += 1
+                self._strike(engine_name, f"dispatch failed: {e}")
+                resq.put((engine_name, "err", e))
+            finally:
+                self._release(engine_name)
+
+        def launch(engine_name: str, site: Optional[str]) -> None:
+            threading.Thread(
+                target=run, args=(engine_name, site),
+                name=f"route-{engine_name}", daemon=True).start()
+
+        if self.spec.hedge != "on" or len(self._members) <= 1:
+            # inline fast path: same code, no thread, no hedge
+            run(name, "fleet.dispatch")
+            ename, kind, payload = resq.get_nowait()
+            if kind == "err":
+                raise payload
+            return ename, payload
+
+        launch(name, "fleet.dispatch")
+        pending = {name}
+        hedge_name: Optional[str] = None
+        tried_hedge = False
+        excs: Dict[str, BaseException] = {}
+        winner, out = None, None
+        while pending:
+            tmo = None if tried_hedge else self._hedge_delay()
+            try:
+                ename, kind, payload = resq.get(timeout=tmo)
+            except queue.Empty:
+                tried_hedge = True
+                hedge_name = self._try_hedge(
+                    set(cancels), cancels, launch, deadline)
+                if hedge_name is not None:
+                    pending.add(hedge_name)
+                continue
+            pending.discard(ename)
+            if kind == "ok":
+                winner, out = ename, payload
+                break
+            if not isinstance(payload, Cancelled):
+                excs[ename] = payload
+        if winner is not None:
+            for n, ev in cancels.items():
+                if n != winner:
+                    ev.set()
+            if winner == hedge_name:
+                self.stats.count("hedge_wins")
+            return winner, out
+        # every launched attempt failed: the PRIMARY's outcome decides
+        # the retry story (the hedge was opportunistic)
+        exc = excs.get(name)
+        if exc is None and excs:
+            exc = next(iter(excs.values()))
+        raise exc if exc is not None else EngineUnavailable(
+            f"engine {name} vanished mid-dispatch")
+
     def route(self, mode: str, tokens,
-              timeout: Optional[float] = None) -> Dict[str, Any]:
+              timeout: Optional[float] = None,
+              deadline: Optional[float] = None,
+              priority: str = "interactive") -> Dict[str, Any]:
         """Dispatch one request; retries engine failures on other
-        engines and sheds (`Overloaded` + Retry-After) only when no
-        engine can take it.  The result carries `engine`, the member
-        that served it."""
+        engines (every retry and hedge drawing from the global
+        `retry_budget`, and never outliving `deadline`) and sheds
+        (`Overloaded` + per-class Retry-After) only when no engine can
+        take it.  The result carries `engine`, the member that served
+        it."""
+        priority = qos.check_priority(priority)
         if timeout is None:
             timeout = self.spec.request_timeout_s
+        deadline = qos.resolve_deadline(timeout, deadline,
+                                        self.spec.request_timeout_s)
         t0 = time.monotonic()
+        rem = qos.remaining_s(deadline)
+        if rem is not None and rem <= 0:
+            # dead on arrival at the router: no engine ever sees it
+            self.stats.count("expired_on_arrival")
+            raise DeadlineExpired(
+                f"dead on arrival at router: deadline passed "
+                f"{-rem:.3f}s ago")
+        if self._brownout_sheds(priority):
+            self._shed(f"brownout sheds {priority}",
+                       priority=priority, brownout=True)
         self.stats.count("routed")
+        self.retry_budget.earn()      # the primary dispatch's earning
         budget = (self.spec.max_attempts
                   if self.spec.max_attempts > 0 else len(self._members))
         tried: set = set()
         saturated = 0
-        with obs.span("router.dispatch", mode=mode) as sp:
+        budget_stopped = False
+        last_exc: Optional[BaseException] = None
+        with obs.span("router.dispatch", mode=mode,
+                      priority=priority) as sp:
             for attempt in range(budget):
+                rem = qos.remaining_s(deadline)
+                if rem is not None and rem <= 0:
+                    # a retry must never outlive the client deadline
+                    self.stats.count("deadline_terminal")
+                    raise DeadlineExpired(
+                        f"deadline exhausted after {attempt} "
+                        f"attempt(s)")
+                if attempt > 0 and not self.retry_budget.spend():
+                    self.stats.count("budget_denied")
+                    budget_stopped = True
+                    break             # single-shot: first outcome stands
                 name = self._pick(tried)
                 if name is None:
+                    if attempt > 0:
+                        self.retry_budget.refund()
                     break
                 tried.add(name)
-                with self._lock:
-                    m = self._members.get(name)
-                if m is None:          # force-retired between pick/use
-                    self.stats.count("retried")
-                    continue
                 try:
-                    faults.maybe_fault("fleet.dispatch")
-                    out = m.handle.request(mode, tokens,
-                                           timeout=timeout)
-                except Overloaded:
+                    winner, out = self._hedged_request(
+                        name, mode, tokens, timeout, deadline,
+                        priority)
+                except Overloaded as e:
                     # load, not failure: no strike, try a sibling
                     saturated += 1
+                    last_exc = e
                     self.stats.count("retried")
                     continue
                 except (DeadlineExpired, TimeoutError):
                     # the request's own deadline died inside the
-                    # engine; retrying elsewhere would only blow it
-                    # further — surface it
-                    self.stats.count("failed")
+                    # engine — not an engine failure, no strike, and
+                    # retrying elsewhere would only blow it further
+                    self.stats.count("deadline_terminal")
                     raise
                 except ValueError:
                     self.stats.count("failed")
                     raise          # unservable request, not a failure
                 except Exception as e:  # noqa: BLE001 — engine failure
-                    with self._lock:
-                        m.failed += 1
-                    self._strike(name, f"dispatch failed: {e}")
+                    # (strike already charged inside _hedged_request)
+                    last_exc = e
                     self.stats.count("retried")
                     continue
-                finally:
-                    self._release(name)
                 with self._lock:
-                    m.dispatched += 1
-                    self._sheds_in_a_row = 0
+                    m = self._members.get(winner)
+                    if m is not None:
+                        m.dispatched += 1
+                self._shed_backoffs.reset(priority)
                 self.stats.count("completed")
-                self.stats.observe_latency(time.monotonic() - t0)
-                out["engine"] = name
-                sp.set(engine=name, attempts=attempt + 1)
+                self.stats.observe_latency(time.monotonic() - t0,
+                                           priority)
+                out["engine"] = winner
+                sp.set(engine=winner, attempts=attempt + 1)
                 return out
+            if budget_stopped and last_exc is not None:
+                # the retry budget ran dry: degrade to single-shot —
+                # the first attempt's outcome stands, the request is
+                # never shed BECAUSE of the budget
+                if isinstance(last_exc, Overloaded):
+                    self.stats.observe_shed(priority)
+                    raise last_exc    # the engine's honest Retry-After
+                self.stats.count("failed")
+                raise EngineUnavailable(
+                    f"dispatch failed, retry budget exhausted "
+                    f"({len(tried)} engine(s) tried): {last_exc}"
+                ) from last_exc
             # nothing left to try: the fleet is saturated or down
             why = ("fleet saturated" if saturated
                    else "no healthy engine available"
                    if not tried else
                    f"all {len(tried)} reachable engine(s) failed")
-            self._shed(why)
+            self._shed(why, priority=priority)
+
+    def _call_stream(self, name: str, tokens, timeout, max_new,
+                     deadline, priority, cancel_event):
+        with self._lock:
+            m = self._members.get(name)
+        if m is None:
+            raise EngineUnavailable(f"engine {name} retired "
+                                    f"mid-dispatch")
+        return _handle_call(
+            m.handle.request_stream, (tokens,),
+            {"timeout": timeout, "max_new": max_new,
+             "deadline": deadline, "priority": priority,
+             "cancel_event": cancel_event})
+
+    def _hedged_stream(self, name: str, tokens, timeout, max_new,
+                       deadline, priority) -> tuple:
+        """Streaming twin of `_hedged_request`: FIRST BYTE wins — each
+        attempt admits its stream and pulls one event; whichever
+        event lands first commits that engine, the loser's
+        cancel_event tears its slot down mid-decode.  Returns
+        (winner, first_event, generator) with the winner's in-flight
+        slot STILL HELD (released by `_wrap_stream`)."""
+        resq: "queue.Queue" = queue.Queue()
+        sel = threading.Lock()
+        state = {"done": False}
+        cancels: Dict[str, threading.Event] = {name: threading.Event()}
+
+        def run(engine_name: str, site: Optional[str]) -> None:
+            self.stats.count("attempts")
+            ev = cancels[engine_name]
+            try:
+                if site is not None:
+                    faults.maybe_fault(site)
+                gen = self._call_stream(engine_name, tokens, timeout,
+                                        max_new, deadline, priority,
+                                        ev)
+                first = next(gen)      # the first-byte commit
+            except (Overloaded, DeadlineExpired, TimeoutError,
+                    ValueError, Cancelled, StopIteration) as e:
+                self._release(engine_name)
+                resq.put((engine_name, "err", e))
+                return
+            except BaseException as e:  # noqa: BLE001 — engine failure
+                self._release(engine_name)
+                with self._lock:
+                    mm = self._members.get(engine_name)
+                    if mm is not None:
+                        mm.failed += 1
+                self._strike(engine_name,
+                             f"stream dispatch failed: {e}")
+                resq.put((engine_name, "err", e))
+                return
+            with sel:
+                late = state["done"]
+                if not late:
+                    # success keeps its in-flight slot held for
+                    # _wrap_stream — no release here
+                    resq.put((engine_name, "ok", (first, gen)))
+            if late:                   # a winner was already chosen
+                gen.close()
+                self._release(engine_name)
+
+        def launch(engine_name: str, site: Optional[str]) -> None:
+            threading.Thread(
+                target=run, args=(engine_name, site),
+                name=f"stream-{engine_name}", daemon=True).start()
+
+        if self.spec.hedge != "on" or len(self._members) <= 1:
+            run(name, "fleet.dispatch")
+            ename, kind, payload = resq.get_nowait()
+            if kind == "err":
+                raise payload
+            return ename, payload[0], payload[1]
+
+        launch(name, "fleet.dispatch")
+        pending = {name}
+        hedge_name: Optional[str] = None
+        tried_hedge = False
+        excs: Dict[str, BaseException] = {}
+        winner = first = gen = None
+        while pending:
+            tmo = None if tried_hedge else self._hedge_delay()
+            try:
+                ename, kind, payload = resq.get(timeout=tmo)
+            except queue.Empty:
+                tried_hedge = True
+                hedge_name = self._try_hedge(
+                    set(cancels), cancels, launch, deadline)
+                if hedge_name is not None:
+                    pending.add(hedge_name)
+                continue
+            pending.discard(ename)
+            if kind == "ok":
+                winner, (first, gen) = ename, payload
+                break
+            if not isinstance(payload, Cancelled):
+                excs[ename] = payload
+        with sel:
+            state["done"] = True
+        # any "ok" result in the queue now is a loser that beat the
+        # state flag: close it and give back its slot
+        while True:
+            try:
+                ename, kind, payload = resq.get_nowait()
+            except queue.Empty:
+                break
+            if kind == "ok":
+                payload[1].close()
+                self._release(ename)
+        if winner is not None:
+            for n, ev in cancels.items():
+                if n != winner:
+                    ev.set()
+            if winner == hedge_name:
+                self.stats.count("hedge_wins")
+            return winner, first, gen
+        exc = excs.get(name)
+        if exc is None and excs:
+            exc = next(iter(excs.values()))
+        raise exc if exc is not None else EngineUnavailable(
+            f"engine {name} vanished mid-dispatch")
 
     def route_stream(self, tokens, timeout: Optional[float] = None,
-                     max_new: Optional[int] = None):
+                     max_new: Optional[int] = None,
+                     deadline: Optional[float] = None,
+                     priority: str = "interactive"):
         """Streaming dispatch: pick an engine exactly like `route`,
         but return its token-event iterator instead of a buffered
-        result.  Retry-on-other-engine applies ONLY until the chosen
-        engine admits the stream (its `request_stream` returning is
-        the first-byte commit) — after that a failure surfaces to the
-        caller, because tokens may already be on the wire and a
-        replay would duplicate them.  The engine's in-flight slot is
-        held until the consumer exhausts (or abandons) the stream."""
+        result.  Retry-on-other-engine applies ONLY until the first
+        byte (a hedge's losing stream is cancelled, never replayed) —
+        after that a failure surfaces to the caller, because tokens
+        may already be on the wire and a replay would duplicate them.
+        The engine's in-flight slot is held until the consumer
+        exhausts (or abandons) the stream."""
+        priority = qos.check_priority(priority)
         if timeout is None:
             timeout = self.spec.request_timeout_s
+        deadline = qos.resolve_deadline(timeout, deadline,
+                                        self.spec.request_timeout_s)
         t0 = time.monotonic()
+        rem = qos.remaining_s(deadline)
+        if rem is not None and rem <= 0:
+            self.stats.count("expired_on_arrival")
+            raise DeadlineExpired(
+                f"dead on arrival at router: deadline passed "
+                f"{-rem:.3f}s ago")
+        if self._brownout_sheds(priority):
+            self._shed(f"brownout sheds {priority}",
+                       priority=priority, brownout=True)
         self.stats.count("routed")
+        self.retry_budget.earn()
         budget = (self.spec.max_attempts
                   if self.spec.max_attempts > 0 else len(self._members))
         tried: set = set()
         saturated = 0
-        for _attempt in range(budget):
+        budget_stopped = False
+        last_exc: Optional[BaseException] = None
+        for attempt in range(budget):
+            rem = qos.remaining_s(deadline)
+            if rem is not None and rem <= 0:
+                self.stats.count("deadline_terminal")
+                raise DeadlineExpired(
+                    f"deadline exhausted after {attempt} attempt(s)")
+            if attempt > 0 and not self.retry_budget.spend():
+                self.stats.count("budget_denied")
+                budget_stopped = True
+                break
             name = self._pick(tried)
             if name is None:
+                if attempt > 0:
+                    self.retry_budget.refund()
                 break
             tried.add(name)
-            with self._lock:
-                m = self._members.get(name)
-            if m is None:              # force-retired between pick/use
-                self.stats.count("retried")
-                continue
             try:
-                faults.maybe_fault("fleet.dispatch")
-                stream = m.handle.request_stream(tokens,
-                                                 timeout=timeout,
-                                                 max_new=max_new)
-            except Overloaded:
-                self._release(name)
+                winner, first, gen = self._hedged_stream(
+                    name, tokens, timeout, max_new, deadline,
+                    priority)
+            except Overloaded as e:
                 saturated += 1
+                last_exc = e
                 self.stats.count("retried")
                 continue
-            except (DeadlineExpired, TimeoutError, ValueError):
-                self._release(name)
+            except (DeadlineExpired, TimeoutError):
+                self.stats.count("deadline_terminal")
+                raise
+            except ValueError:
                 self.stats.count("failed")
                 raise
             except Exception as e:  # noqa: BLE001 — engine failure
-                self._release(name)
-                with self._lock:
-                    m.failed += 1
-                self._strike(name, f"stream dispatch failed: {e}")
+                last_exc = e
                 self.stats.count("retried")
                 continue
             # committed to this engine: wrap the stream so the
             # in-flight accounting survives however the consumer
             # finishes (exhaustion, error, or abandonment)
-            return self._wrap_stream(name, stream, t0)
+            return self._wrap_stream(winner, first, gen, t0, priority)
+        if budget_stopped and last_exc is not None:
+            if isinstance(last_exc, Overloaded):
+                self.stats.observe_shed(priority)
+                raise last_exc
+            self.stats.count("failed")
+            raise EngineUnavailable(
+                f"stream dispatch failed, retry budget exhausted "
+                f"({len(tried)} engine(s) tried): {last_exc}"
+            ) from last_exc
         why = ("fleet saturated" if saturated
                else "no healthy engine available"
                if not tried else
                f"all {len(tried)} reachable engine(s) failed")
-        self._shed(why)
+        self._shed(why, priority=priority)
 
-    def _wrap_stream(self, name: str, stream, t0: float):
+    def _wrap_stream(self, name: str, first, stream, t0: float,
+                     priority: str = "interactive"):
         with self._lock:
             m = self._members.get(name)
+
+        def events():
+            yield first
+            for ev in stream:
+                yield ev
 
         def gen():
             finished = False
             try:
-                for ev in stream:
+                for ev in events():
                     if ev.get("done"):
                         ev.setdefault("engine", name)
                         finished = True
@@ -830,20 +1351,20 @@ class Router:
                     with self._lock:
                         if m is not None:
                             m.dispatched += 1
-                        self._sheds_in_a_row = 0
+                    self._shed_backoffs.reset(priority)
                     self.stats.count("completed")
-                    self.stats.observe_latency(time.monotonic() - t0)
+                    self.stats.observe_latency(time.monotonic() - t0,
+                                               priority)
                 else:
                     self.stats.count("failed")
         return gen()
 
-    def _shed(self, why: str) -> None:
-        with self._lock:
-            self._sheds_in_a_row += 1
-            attempt = self._sheds_in_a_row
-        self.stats.count("shed")
-        retry = self._shed_backoff.delay(attempt - 1)
+    def _shed(self, why: str, priority: str = "interactive",
+              brownout: bool = False) -> None:
+        self.stats.observe_shed(priority, brownout=brownout)
+        retry = self._shed_backoffs.shed_delay(priority)
         obs.emit_event("serve.shed", why=f"router: {why}",
+                       priority=priority,
                        retry_after=round(retry, 4))
         raise Overloaded(f"request shed ({why}); retry after "
                          f"{retry:.3f}s", retry_after=retry)

@@ -58,7 +58,8 @@ import numpy as np
 
 from .. import obs
 from ..utils import faults
-from .batcher import DeadlineExpired, Overloaded
+from . import qos
+from .batcher import Cancelled, DeadlineExpired, Overloaded
 from .engine import InferenceEngine
 from .kvcache import PagedKVCache
 from .stats import ServeStats
@@ -133,6 +134,8 @@ class _CBRequest:
     t_submit: float
     deadline: Optional[float]
     corr: str
+    priority: str = "interactive"
+    cancel_event: Optional[threading.Event] = None
     t_admit: float = 0.0
     produced: List[int] = field(default_factory=list)
 
@@ -157,7 +160,12 @@ class ContinuousScheduler:
         self._pending: deque = deque()
         self._cv = threading.Condition()
         self._req_ids = itertools.count(1)
-        self._sheds_in_a_row = 0
+        # per-class shed streaks/backoffs (see serve/qos.py); the
+        # interactive stream matches the old single-class behavior
+        self._class_backoffs = qos.ClassBackoffs(
+            base=getattr(self._backoff, "base", 0.05),
+            cap=getattr(self._backoff, "cap", 2.0),
+            seed=getattr(self._backoff, "seed", self.spec.seed))
         self._stop = False
         self._thread: Optional[threading.Thread] = None
         # slot state (numpy, scheduler-thread-owned)
@@ -217,11 +225,22 @@ class ContinuousScheduler:
 
     # -- admission ----------------------------------------------------------
     def submit(self, tokens, timeout: Optional[float] = None,
-               max_new: Optional[int] = None) -> StreamTicket:
+               max_new: Optional[int] = None,
+               deadline: Optional[float] = None,
+               priority: str = "interactive",
+               cancel_event: Optional[threading.Event] = None
+               ) -> StreamTicket:
         """Admit one generate request.  `max_new` caps this request's
-        generation (clamped to spec.max_new_tokens).  Raises
-        ValueError for a never-servable prompt (fail fast, the HTTP
-        layer's 400), `Overloaded` when the pending queue is full."""
+        generation (clamped to spec.max_new_tokens).  `deadline`
+        (absolute monotonic; wins over `timeout`) is the request's
+        end-to-end budget — dead on arrival is refused before any
+        queue or engine work (`expired_on_arrival`); `priority` drives
+        brownout admission; a set `cancel_event` drops the request at
+        the next scheduler touch (queued or mid-decode, counted
+        `cancelled`).  Raises ValueError for a never-servable prompt
+        or unknown priority (fail fast, the HTTP layer's 400),
+        `Overloaded` when the pending queue is full or brownout sheds
+        this class."""
         spec = self.spec
         arr = np.asarray(tokens, np.int32).reshape(-1)
         if arr.size < 1:
@@ -238,44 +257,74 @@ class ContinuousScheduler:
             self.stats.count("rejected")
             raise ValueError(f"max_new must be >= 1, got {mn}")
         mn = min(mn, int(spec.max_new_tokens))
+        try:
+            priority = qos.check_priority(priority)
+        except ValueError:
+            self.stats.count("rejected")
+            raise
         nblocks = -(-(int(arr.size) + mn) // int(spec.cb_block_len))
-        if timeout is None:
-            timeout = spec.request_timeout_s
+        deadline = qos.resolve_deadline(timeout, deadline,
+                                        spec.request_timeout_s)
         now = time.monotonic()
+        if deadline is not None and now >= deadline:
+            # dead on arrival: refuse before it queues — zero engine
+            # steps burned on a client that already gave up
+            self.stats.count("expired_on_arrival")
+            raise DeadlineExpired(
+                f"dead on arrival: deadline passed "
+                f"{now - deadline:.3f}s before admission")
         corr = f"cbreq-{next(self._req_ids)}"
         req = _CBRequest(tokens=arr, plen=int(arr.size), max_new=mn,
                          nblocks=nblocks, ticket=StreamTicket(corr),
-                         t_submit=now,
-                         deadline=(now + timeout) if timeout > 0
-                         else None, corr=corr)
+                         t_submit=now, deadline=deadline, corr=corr,
+                         priority=priority, cancel_event=cancel_event)
         with obs.span("scheduler.admit", corr=corr,
-                      plen=int(arr.size), max_new=mn):
+                      plen=int(arr.size), max_new=mn,
+                      priority=priority):
             try:
                 faults.maybe_fault("serve.admit")
             except faults.FaultError as e:
-                self._shed(f"admission fault: {e}", corr=corr)
+                self._shed(f"admission fault: {e}", corr=corr,
+                           priority=priority)
             with self._cv:
                 if self._stop:
                     raise RuntimeError("scheduler is stopped")
-                if len(self._pending) >= spec.queue_capacity:
+                depth = len(self._pending)
+                if depth >= spec.queue_capacity or \
+                        not self._brownout_admits(priority, depth):
                     pass          # shed outside the happy path below
                 else:
                     self._pending.append(req)
-                    self._sheds_in_a_row = 0
+                    self._class_backoffs.reset(priority)
                     self.stats.count("submitted")
                     self.stats.gauge("queue_depth", len(self._pending))
                     self._cv.notify()
                     return req.ticket
-            self._shed(f"queue full ({spec.queue_capacity} requests)",
-                       corr=corr)
+            if depth >= spec.queue_capacity:
+                why = f"queue full ({spec.queue_capacity} requests)"
+            else:
+                why = (f"brownout: queue {depth}/"
+                       f"{spec.queue_capacity} sheds {priority}")
+            self._shed(why, corr=corr, priority=priority)
 
-    def _shed(self, why: str, corr: Optional[str] = None) -> None:
-        with self._cv:
-            self._sheds_in_a_row += 1
-            attempt = self._sheds_in_a_row
+    def _brownout_admits(self, priority: str, depth: int) -> bool:
+        """Class-aware admission under pressure: best_effort is shed
+        once the pending queue is `brownout_be_frac` full, batch at
+        `brownout_batch_frac`; interactive rides to the cap."""
+        if priority == "interactive":
+            return True
+        frac = (self.spec.brownout_be_frac
+                if priority == "best_effort"
+                else self.spec.brownout_batch_frac)
+        return depth < max(int(frac * self.spec.queue_capacity), 1)
+
+    def _shed(self, why: str, corr: Optional[str] = None,
+              priority: str = "interactive") -> None:
         self.stats.count("shed")
-        retry = self._backoff.delay(attempt - 1)
+        self.stats.count(f"shed_{priority}")
+        retry = self._class_backoffs.shed_delay(priority)
         obs.emit_event("serve.shed", why=why, corr=corr,
+                       priority=priority,
                        retry_after=round(retry, 4))
         raise Overloaded(f"request shed ({why}); retry after "
                          f"{retry:.3f}s", retry_after=retry)
@@ -315,13 +364,21 @@ class ContinuousScheduler:
         with self._cv:
             keep: deque = deque()
             expired: List[_CBRequest] = []
+            cancelled: List[_CBRequest] = []
             for r in self._pending:
-                if r.deadline is not None and now > r.deadline:
+                if r.cancel_event is not None and \
+                        r.cancel_event.is_set():
+                    cancelled.append(r)
+                elif r.deadline is not None and now > r.deadline:
                     expired.append(r)
                 else:
                     keep.append(r)
             self._pending = keep
             self.stats.gauge("queue_depth", len(self._pending))
+        for r in cancelled:
+            self.stats.count("cancelled")
+            r.ticket._fail(Cancelled(
+                "cancelled by caller while queued"))
         for r in expired:
             self.stats.count("expired")
             r.ticket._fail(DeadlineExpired(
@@ -341,8 +398,24 @@ class ContinuousScheduler:
                     return
                 req = self._pending.popleft()
                 self.stats.gauge("queue_depth", len(self._pending))
+            # last-instant guard AFTER the pop, BEFORE any blocks or
+            # engine work: an engine never prefills a request that is
+            # already dead or cancelled
+            now = time.monotonic()
+            if req.cancel_event is not None and \
+                    req.cancel_event.is_set():
+                self.stats.count("cancelled")
+                req.ticket._fail(Cancelled(
+                    "cancelled by caller before prefill"))
+                continue
+            if req.deadline is not None and now >= req.deadline:
+                self.stats.count("expired")
+                req.ticket._fail(DeadlineExpired(
+                    f"deadline passed after {now - req.t_submit:.3f}s "
+                    f"in queue"))
+                continue
             slot = int(free[0])
-            req.t_admit = time.monotonic()
+            req.t_admit = now
             row = self.kv.alloc(slot, req.nblocks)
             toks = np.zeros((1, spec.cb_prefill_len), np.int32)
             toks[0, :req.plen] = req.tokens
@@ -392,7 +465,11 @@ class ContinuousScheduler:
                       now: float) -> None:
         req = self._slot_req[slot]
         eos = self.spec.eos_id
-        if eos is not None and tok == eos:
+        if req.cancel_event is not None and req.cancel_event.is_set():
+            # hedge loser mid-decode: free the slot THIS step — the
+            # winner's fleet keeps the capacity, not a dead stream
+            self._retire(slot, "cancelled", step_no)
+        elif eos is not None and tok == eos:
             self._retire(slot, "eos", step_no)
         elif len(req.produced) >= req.max_new:
             self._retire(slot, "length", step_no)
@@ -410,6 +487,16 @@ class ContinuousScheduler:
         if finish == "shutdown":
             self.stats.count("failed")
             req.ticket._fail(RuntimeError("server shutting down"))
+            return
+        if finish == "cancelled":
+            # not a completion, not a failure: no latency sample, no
+            # strike — the caller asked for it (hedge loser)
+            self.stats.count("cancelled")
+            obs.emit_event("serve.cb_retire", corr=req.corr,
+                           finish=finish, tokens=len(req.produced),
+                           slot=slot)
+            req.ticket._fail(Cancelled(
+                "cancelled by caller mid-decode"))
             return
         self.stats.observe_latency(now - req.t_submit)
         self.stats.observe_request(req.t_admit - req.t_submit,

@@ -58,6 +58,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from ..obs.metrics import MetricsRegistry
+from . import qos
 from .batcher import DeadlineExpired, MicroBatcher, Overloaded
 from .engine import InferenceEngine, ServeSpec  # noqa: F401 (re-export)
 from .scheduler import ContinuousScheduler, StreamTicket
@@ -168,21 +169,32 @@ class InferenceServer:
 
     # -- in-process client API ---------------------------------------------
     def generate(self, tokens, timeout: Optional[float] = None,
-                 max_new: Optional[int] = None) -> Dict[str, Any]:
+                 max_new: Optional[int] = None,
+                 deadline: Optional[float] = None,
+                 priority: str = "interactive",
+                 cancel_event: Optional[threading.Event] = None
+                 ) -> Dict[str, Any]:
         """Submit one prompt and block for the decoded continuation.
         Raises Overloaded / DeadlineExpired / TimeoutError exactly as
         the HTTP layer maps them.  `max_new` caps this request's
         generation under cb; the static bucket path decodes the full
         spec.max_new_tokens regardless (the whole batch shares one
-        compiled program) and only trims the reply."""
+        compiled program) and only trims the reply.  `deadline`
+        (absolute monotonic) is the request's end-to-end budget and
+        wins over `timeout`; `priority` / `cancel_event` flow to
+        admission (serve/qos.py)."""
         t0 = time.monotonic()
         if self.scheduler is not None:
-            ticket = self.scheduler.submit(tokens, timeout=timeout,
-                                           max_new=max_new)
+            ticket = self.scheduler.submit(
+                tokens, timeout=timeout, max_new=max_new,
+                deadline=deadline, priority=priority,
+                cancel_event=cancel_event)
         else:
-            ticket = self.batcher.submit(tokens, mode="generate",
-                                         timeout=timeout)
-        out = ticket.wait(self._wait_budget(timeout))
+            ticket = self.batcher.submit(
+                tokens, mode="generate", timeout=timeout,
+                deadline=deadline, priority=priority,
+                cancel_event=cancel_event)
+        out = ticket.wait(self._wait_budget(timeout, deadline))
         if self.scheduler is None and max_new is not None \
                 and int(max_new) >= 1:
             out["tokens"] = out["tokens"][:int(max_new)]
@@ -191,7 +203,11 @@ class InferenceServer:
 
     def generate_stream(self, tokens,
                         timeout: Optional[float] = None,
-                        max_new: Optional[int] = None) -> StreamTicket:
+                        max_new: Optional[int] = None,
+                        deadline: Optional[float] = None,
+                        priority: str = "interactive",
+                        cancel_event: Optional[threading.Event] = None
+                        ) -> StreamTicket:
         """Streaming admission (cb only): returns the request's
         `StreamTicket` — iterate `.tokens()` / `.events()` for tokens
         as slots produce them.  Raises RuntimeError when the server
@@ -199,23 +215,36 @@ class InferenceServer:
         if self.scheduler is None:
             raise RuntimeError("streaming generate needs cb=on in the "
                                "serve spec")
-        return self.scheduler.submit(tokens, timeout=timeout,
-                                     max_new=max_new)
+        return self.scheduler.submit(
+            tokens, timeout=timeout, max_new=max_new,
+            deadline=deadline, priority=priority,
+            cancel_event=cancel_event)
 
     def predict(self, tokens,
-                timeout: Optional[float] = None) -> Dict[str, Any]:
+                timeout: Optional[float] = None,
+                deadline: Optional[float] = None,
+                priority: str = "interactive",
+                cancel_event: Optional[threading.Event] = None
+                ) -> Dict[str, Any]:
         """Next-token log-probs for one prompt (LM scoring)."""
         t0 = time.monotonic()
-        ticket = self.batcher.submit(tokens, mode="predict",
-                                     timeout=timeout)
-        out = ticket.wait(self._wait_budget(timeout))
+        ticket = self.batcher.submit(
+            tokens, mode="predict", timeout=timeout,
+            deadline=deadline, priority=priority,
+            cancel_event=cancel_event)
+        out = ticket.wait(self._wait_budget(timeout, deadline))
         out["latency_ms"] = round((time.monotonic() - t0) * 1e3, 3)
         return out
 
-    def _wait_budget(self, timeout: Optional[float]) -> float:
+    def _wait_budget(self, timeout: Optional[float],
+                     deadline: Optional[float] = None) -> float:
         # queue deadline + generous dispatch slack: wait() must outlive
         # the in-queue deadline so expiry surfaces as DeadlineExpired,
-        # not a bare TimeoutError
+        # not a bare TimeoutError.  An explicit absolute deadline wins
+        # (its remaining budget IS the queue bound).
+        rem = qos.remaining_s(deadline)
+        if rem is not None:
+            return max(rem, 0.1) + 30.0
         base = (timeout if timeout and timeout > 0
                 else self.engine.spec.request_timeout_s)
         return max(base, 0.1) + 30.0
@@ -290,18 +319,30 @@ def _make_handler(server: InferenceServer):
                 req = json.loads(self.rfile.read(n) or b"{}")
                 tokens = np.asarray(req["tokens"], np.int32)
                 timeout = req.get("timeout")
+                # end-to-end deadline: remaining-ms header re-anchored
+                # onto THIS process's monotonic clock (serve/qos.py)
+                deadline = qos.deadline_from_header(
+                    self.headers.get(qos.DEADLINE_HEADER))
+                priority = qos.check_priority(
+                    req.get("priority")
+                    or self.headers.get(qos.PRIORITY_HEADER))
                 if mode == "generate":
                     max_new = req.get("max_new")
                     if max_new is not None:
                         max_new = int(max_new)
                     if req.get("stream") and \
                             server.scheduler is not None:
-                        self._stream_generate(tokens, timeout, max_new)
+                        self._stream_generate(tokens, timeout, max_new,
+                                              deadline, priority)
                         return
                     out = server.generate(tokens, timeout=timeout,
-                                          max_new=max_new)
+                                          max_new=max_new,
+                                          deadline=deadline,
+                                          priority=priority)
                 else:
-                    out = server.predict(tokens, timeout=timeout)
+                    out = server.predict(tokens, timeout=timeout,
+                                         deadline=deadline,
+                                         priority=priority)
                 self._reply(200, out)
             except Overloaded as e:
                 self._reply(503, {"error": str(e),
@@ -318,7 +359,9 @@ def _make_handler(server: InferenceServer):
             self.wfile.write(f"{len(data):X}\r\n".encode()
                              + data + b"\r\n")
 
-        def _stream_generate(self, tokens, timeout, max_new) -> None:
+        def _stream_generate(self, tokens, timeout, max_new,
+                             deadline=None,
+                             priority="interactive") -> None:
             """Chunked-transfer ndjson: one {"token": t} line per
             produced token as the slot produces it, then a final
             {"done": true, ...} summary line.  Admission errors raise
@@ -327,14 +370,16 @@ def _make_handler(server: InferenceServer):
             {"error": ...} line (the 200 is already on the wire)."""
             t0 = time.monotonic()
             ticket = server.scheduler.submit(tokens, timeout=timeout,
-                                             max_new=max_new)
+                                             max_new=max_new,
+                                             deadline=deadline,
+                                             priority=priority)
             self.send_response(200)
             self.send_header("Content-Type", "application/x-ndjson")
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
             try:
                 for kind, payload in ticket.events(
-                        timeout=server._wait_budget(timeout)):
+                        timeout=server._wait_budget(timeout, deadline)):
                     if kind == "tok":
                         line = {"token": payload}
                     else:

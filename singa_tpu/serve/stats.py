@@ -78,7 +78,16 @@ class ServeStats:
         self.completed = 0
         self.failed = 0          # engine/batch errors surfaced to requests
         self.expired = 0         # deadline passed before dispatch
+        self.expired_on_arrival = 0  # dead on arrival: never queued,
+                                     # never prefilled — zero engine
+                                     # steps burned (serve/qos.py)
+        self.cancelled = 0       # cancelled by the caller (hedge loser)
         self.shed = 0            # admission rejected (queue full / fault)
+        # per-class brownout accounting (every class shed also counts
+        # in `shed`; these split it by priority)
+        self.shed_interactive = 0
+        self.shed_batch = 0
+        self.shed_best_effort = 0
         self.rejected = 0        # never-servable request (fast 400)
         self.queue_depth = 0     # gauge: requests waiting right now
         self.generated_tokens = 0
@@ -162,8 +171,9 @@ class ServeStats:
 
     # -- reads -------------------------------------------------------------
     def latency_quantile(self, q: float) -> Optional[float]:
-        """Seconds at quantile `q` over the recent-completion reservoir
-        (nearest-rank), or None before any completion."""
+        """Seconds at quantile `q` (p50/p95/p99 in snapshot) over the
+        recent-completion reservoir (nearest-rank), or None before any
+        completion."""
         with self._lock:
             lats = sorted(self._latencies)
         if not lats:
@@ -283,6 +293,7 @@ class ServeStats:
             "shed_rate": round(shed / max(shed + len(lats), 1), 4),
             "p50_latency_ms": q(0.5),
             "p95_latency_ms": q(0.95),
+            "p99_latency_ms": q(0.99),
         }
 
     def register_into(self, registry,
@@ -295,14 +306,18 @@ class ServeStats:
         from ..obs.metrics import Sample
 
         counters = ("submitted", "completed", "failed", "expired",
-                    "shed", "rejected", "generated_tokens", "batches",
+                    "expired_on_arrival", "cancelled", "shed",
+                    "shed_interactive", "shed_batch",
+                    "shed_best_effort", "rejected",
+                    "generated_tokens", "batches",
                     "batched_requests", "batch_slots", "cb_steps",
                     "compiles", "reloads", "reload_failures",
                     "reloads_refused", "torn_polls")
         gauges = ("queue_depth", "consecutive_batch_failures", "qps",
                   "qps_recent", "uptime_s", "p50_latency_ms",
-                  "p95_latency_ms", "shed_rate_recent",
-                  "p95_latency_recent_ms", "p50_queue_wait_ms",
+                  "p95_latency_ms", "p99_latency_ms",
+                  "shed_rate_recent", "p95_latency_recent_ms",
+                  "p99_latency_recent_ms", "p50_queue_wait_ms",
                   "p95_queue_wait_ms", "p50_service_ms",
                   "p95_service_ms", "p50_tokens_per_s",
                   "p95_tokens_per_s", "batch_occupancy",
@@ -324,8 +339,9 @@ class ServeStats:
 
     def snapshot(self) -> Dict[str, Any]:
         """JSON-ready view for /stats and BENCH_pr5.json."""
-        p50, p95 = (self.latency_quantile(0.50),
-                    self.latency_quantile(0.95))
+        p50, p95, p99 = (self.latency_quantile(0.50),
+                         self.latency_quantile(0.95),
+                         self.latency_quantile(0.99))
         occ = self.occupancy()
         cb_occ = self.cb_slot_occupancy()
         cb_occ_recent = self.cb_slot_occupancy_recent()
@@ -336,7 +352,12 @@ class ServeStats:
                 "completed": self.completed,
                 "failed": self.failed,
                 "expired": self.expired,
+                "expired_on_arrival": self.expired_on_arrival,
+                "cancelled": self.cancelled,
                 "shed": self.shed,
+                "shed_interactive": self.shed_interactive,
+                "shed_batch": self.shed_batch,
+                "shed_best_effort": self.shed_best_effort,
                 "rejected": self.rejected,
                 "queue_depth": self.queue_depth,
                 "generated_tokens": self.generated_tokens,
@@ -359,11 +380,14 @@ class ServeStats:
         win = self.windowed()
         out["shed_rate_recent"] = win["shed_rate"]
         out["p95_latency_recent_ms"] = win["p95_latency_ms"]
+        out["p99_latency_recent_ms"] = win["p99_latency_ms"]
         out["uptime_s"] = round(self.uptime_s(), 3)
         out["p50_latency_ms"] = (round(p50 * 1e3, 3)
                                  if p50 is not None else None)
         out["p95_latency_ms"] = (round(p95 * 1e3, 3)
                                  if p95 is not None else None)
+        out["p99_latency_ms"] = (round(p99 * 1e3, 3)
+                                 if p99 is not None else None)
         for kind, label in (("queue_wait", "queue_wait_ms"),
                             ("service", "service_ms")):
             for q, pre in ((0.50, "p50"), (0.95, "p95")):

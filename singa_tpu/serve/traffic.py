@@ -19,7 +19,10 @@ Each `Phase` also carries the request-shape mix — long-tail prompt
 lengths and max_new choices with weights — plus a `stream_p` fraction
 of streaming requests, an optional `slow_reader_s` per-token consumer
 delay (the client on hotel wifi that holds a stream slot open), and
-an `on_start` hook for chaos legs (kill an engine mid-ramp).
+an `on_start` hook for chaos legs (kill an engine mid-ramp, or
+`stall_chaos(...)` to turn one replica into a straggler), and a QoS
+`priorities`/`priority_weights` mix for brownout legs — reports then
+break offered/completed/shed/p95 down per class.
 
 `TrafficGen.run(phases)` records, per phase and in total: offered vs
 completed load, sheds (`Overloaded` — the server protecting itself,
@@ -39,6 +42,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from . import qos
 from .batcher import Overloaded
 
 
@@ -57,6 +61,8 @@ class Phase:
     max_new_weights: Optional[Tuple[float, ...]] = None
     stream_p: float = 0.0          # fraction routed as streams
     slow_reader_s: float = 0.0     # per-token consumer stall (streams)
+    priorities: Tuple[str, ...] = ("interactive",)   # QoS class mix
+    priority_weights: Optional[Tuple[float, ...]] = None
     on_start: Optional[Callable[[], None]] = None   # chaos hook
 
     def __post_init__(self):
@@ -69,6 +75,11 @@ class Phase:
         if not 0 <= float(self.stream_p) <= 1:
             raise ValueError(f"phase {self.name!r}: stream_p must be "
                              f"in [0, 1]")
+        for p in self.priorities:
+            if p not in qos.PRIORITIES:
+                raise ValueError(f"phase {self.name!r}: unknown "
+                                 f"priority {p!r} (want one of "
+                                 f"{qos.PRIORITIES})")
 
     def rate_at(self, frac: float) -> float:
         """Instantaneous arrival rate `frac` of the way through."""
@@ -119,6 +130,37 @@ class _PhaseLog:
         self.dropped_harness = 0
         self.latencies: List[float] = []
         self.errors: List[str] = []
+        # per-QoS-class attribution (the brownout gate's raw data)
+        self.offered_by_class: Dict[str, int] = {}
+        self.completed_by_class: Dict[str, int] = {}
+        self.shed_by_class: Dict[str, int] = {}
+        self.lat_by_class: Dict[str, List[float]] = {}
+
+
+def stall_chaos(fleet, name: Optional[str] = None,
+                stall_s: float = 0.25) -> Callable[[], None]:
+    """Chaos `on_start` hook: latch a per-step decode stall onto one
+    LOCAL engine (`InferenceEngine.set_stall`) — the slow-replica leg
+    the hedging gate runs against.  With `name=None` the
+    lexicographically LAST active member is stalled: the Router's
+    least-loaded tie-break prefers earlier names, so the straggler
+    keeps eating its share of traffic through load imbalance rather
+    than winning every pick."""
+    def hook():
+        target = name
+        if target is None:
+            members = sorted(m["name"]
+                             for m in fleet.router.members()
+                             if not m.get("draining"))
+            target = members[-1] if members else None
+        if target is None:
+            return
+        eng = getattr(fleet.router.handle_for(target), "engine", None)
+        if eng is None:
+            raise RuntimeError(f"stall_chaos: {target!r} is not a "
+                               f"local engine (no set_stall)")
+        eng.set_stall(stall_s)
+    return hook
 
 
 class TrafficGen:
@@ -153,6 +195,14 @@ class TrafficGen:
         w = np.asarray(weights, dtype=np.float64)
         return int(rng.choice(list(choices), p=w / w.sum()))
 
+    def _pick_priority(self, rng, phase: Phase) -> str:
+        if len(phase.priorities) == 1:
+            return phase.priorities[0]
+        if phase.priority_weights is None:
+            return str(rng.choice(list(phase.priorities)))
+        w = np.asarray(phase.priority_weights, dtype=np.float64)
+        return str(rng.choice(list(phase.priorities), p=w / w.sum()))
+
     def _fire(self, phase: Phase, log: _PhaseLog, rng_seed: int) -> None:
         rng = np.random.default_rng(rng_seed)
         plen = self._sample(rng, phase.prompt_lens,
@@ -161,17 +211,28 @@ class TrafficGen:
         tokens = rng.integers(1, self.vocab, size=plen).astype(np.int32)
         as_stream = (self.stream_fn is not None
                      and rng.random() < float(phase.stream_p))
+        pri = self._pick_priority(rng, phase)
+        # Back-compat: plain `request_fn(tokens)` targets (tests wrap
+        # bare lambdas) only see the kwarg when the phase actually
+        # mixes classes — "interactive" is every layer's default.
+        kw: Dict[str, Any] = {} if pri == "interactive" \
+            else {"priority": pri}
+        with self._lock:
+            log.offered_by_class[pri] = \
+                log.offered_by_class.get(pri, 0) + 1
         t0 = time.monotonic()
         try:
             if as_stream:
-                for ev in self.stream_fn(tokens, max_new=mnew):
+                for ev in self.stream_fn(tokens, max_new=mnew, **kw):
                     if phase.slow_reader_s > 0 and "token" in ev:
                         time.sleep(phase.slow_reader_s)
             else:
-                self.request_fn(tokens)
+                self.request_fn(tokens, **kw)
         except Overloaded:
             with self._lock:
                 log.shed += 1
+                log.shed_by_class[pri] = \
+                    log.shed_by_class.get(pri, 0) + 1
             return
         except Exception as e:  # noqa: BLE001 — non-shed failure
             with self._lock:
@@ -183,6 +244,9 @@ class TrafficGen:
         with self._lock:
             log.completed += 1
             log.latencies.append(lat)
+            log.completed_by_class[pri] = \
+                log.completed_by_class.get(pri, 0) + 1
+            log.lat_by_class.setdefault(pri, []).append(lat)
 
     def _spawn(self, phase: Phase, log: _PhaseLog, seed: int) -> None:
         with self._lock:
@@ -261,6 +325,20 @@ class TrafficGen:
         s = sorted(lats)
         return round(s[min(int(q * len(s)), len(s) - 1)] * 1e3, 3)
 
+    def _by_class(self, log: _PhaseLog) -> Dict[str, Dict[str, Any]]:
+        out: Dict[str, Dict[str, Any]] = {}
+        for pri in sorted(set(log.offered_by_class)
+                          | set(log.shed_by_class)
+                          | set(log.completed_by_class)):
+            lats = log.lat_by_class.get(pri, [])
+            out[pri] = {
+                "offered": log.offered_by_class.get(pri, 0),
+                "completed": log.completed_by_class.get(pri, 0),
+                "shed": log.shed_by_class.get(pri, 0),
+                "p95_ms": self._quantile(lats, 0.95),
+            }
+        return out
+
     def _report(self, logs: List[_PhaseLog],
                 phases: Sequence[Phase]) -> Dict[str, Any]:
         out_phases = []
@@ -283,6 +361,7 @@ class TrafficGen:
                     "p50_ms": self._quantile(lats, 0.50),
                     "p95_ms": self._quantile(lats, 0.95),
                     "p99_ms": self._quantile(lats, 0.99),
+                    "by_class": self._by_class(log),
                     "errors": list(log.errors),
                 }
             out_phases.append(row)
@@ -293,6 +372,16 @@ class TrafficGen:
             tot.dropped_harness += log.dropped_harness
             tot.latencies.extend(lats)
             tot.errors.extend(log.errors)
+            with self._lock:
+                for d_tot, d_log in (
+                        (tot.offered_by_class, log.offered_by_class),
+                        (tot.completed_by_class,
+                         log.completed_by_class),
+                        (tot.shed_by_class, log.shed_by_class)):
+                    for pri, n in d_log.items():
+                        d_tot[pri] = d_tot.get(pri, 0) + n
+                for pri, ls in log.lat_by_class.items():
+                    tot.lat_by_class.setdefault(pri, []).extend(ls)
         return {
             "phases": out_phases,
             "totals": {
@@ -306,6 +395,7 @@ class TrafficGen:
                 "p50_ms": self._quantile(tot.latencies, 0.50),
                 "p95_ms": self._quantile(tot.latencies, 0.95),
                 "p99_ms": self._quantile(tot.latencies, 0.99),
+                "by_class": self._by_class(tot),
                 "errors": tot.errors[:10],
             },
         }

@@ -47,6 +47,19 @@ restart — so a one-shot fault never re-fires during recovery):
                    is charged to the chosen engine exactly like a real
                    engine failure: the request retries on another
                    engine and the engine earns a strike)
+    serve.hedge    one hedged dispatch fired (Router — an error abandons
+                   that hedge attempt only: the primary dispatch is
+                   untouched and the request's outcome is whatever the
+                   primary returns, so a broken hedge path can never
+                   make tail latency worse than no hedging)
+    engine.stall   one compiled-program invocation (run_batch /
+                   run_cb_prefill / run_cb_decode).  The silent "stall"
+                   kind latches `ServeSpec.stall_fault_s` of host-side
+                   sleep onto THAT engine's every subsequent program
+                   call — the deterministic slow-replica lever the
+                   hedging bench uses to prove a straggler cannot own
+                   p99.  An "error" kind fails that one call (the
+                   batch/step failure story above)
     fleet.rollout  one rollout-controller tick (RolloutController —
                    an error mid-canary aborts the rollout safely:
                    the canary is rolled back to the pinned step and
@@ -87,6 +100,10 @@ Fault kinds:
     spike    no exception — the site scales the value by a large factor
              (an exploding-gradient / corrupted-delta event that stays
              finite)
+    stall    no exception — the site latches an injected latency onto
+             itself (engine.stall: every later compiled call on that
+             engine sleeps `stall_fault_s`; the slow replica that drags
+             fleet p99 without ever failing a health probe)
 
 Instrumented code calls `maybe_fault(site)` — a no-op returning None
 unless a schedule is active via `inject(schedule)`.  Overhead when
@@ -104,15 +121,17 @@ from typing import Dict, List, Optional
 SITES = ("data.decode", "data.prefetch", "feed.stage", "ckpt.save",
          "ckpt.restore", "sync.elastic", "sync.delta", "step.train",
          "step.grad", "serve.admit", "serve.batch", "serve.reload",
-         "fleet.dispatch", "fleet.rollout", "pipeline.publish",
-         "scale.decide", "obs.emit")
+         "serve.hedge", "engine.stall", "fleet.dispatch",
+         "fleet.rollout", "pipeline.publish", "scale.decide",
+         "obs.emit")
 
-KINDS = ("error", "preempt", "corrupt", "torn", "nan", "spike")
+KINDS = ("error", "preempt", "corrupt", "torn", "nan", "spike",
+         "stall")
 
 #: kinds that do not raise: maybe_fault returns the kind string and the
 #: instrumented SITE decides how to honor it (tear a snapshot, poison a
-#: gradient or sync delta)
-SILENT_KINDS = ("torn", "nan", "spike")
+#: gradient or sync delta, latch a latency stall)
+SILENT_KINDS = ("torn", "nan", "spike", "stall")
 
 
 class FaultError(RuntimeError):

@@ -10,7 +10,15 @@ fixed key list.  Unreadable or malformed artifacts get an error row
 instead of being skipped: a report that silently drops a PR reads as
 "that PR had no numbers".
 
+Artifacts listed in REQUIRED_GATES must additionally carry a `gates`
+dict covering every named gate, all passing; a listed artifact that is
+present but missing gates — or recording a failed one — makes the
+report exit non-zero.  A green table over a gateless artifact reads as
+"the acceptance bar held" when nothing was checked.
+
 Usage: python tools/bench_report.py [repo_root]
+Exit status: 0 unless a REQUIRED_GATES artifact is present with
+missing or failing gates.
 """
 
 import glob
@@ -24,8 +32,21 @@ HIGHLIGHT_KEYS = (
     "p50_latency_ms", "p95_latency_ms", "p95_ms", "shed_rate",
     "kill_recovery_s", "canaries", "promotions", "rollbacks",
     "engines_peak", "engines_final", "scale_ups", "scale_downs",
-    "stream_drained", "tok_sec", "qps", "completed", "backend",
+    "stream_drained", "hedge_rate", "retry_amplification",
+    "interactive_p95_ms", "expired_on_arrival", "tok_sec", "qps",
+    "completed", "backend",
 )
+
+# artifact -> gate names its `gates` dict must record as passing.
+# Absent artifacts are fine (older checkouts); present-but-gateless is
+# an error.
+REQUIRED_GATES = {
+    "BENCH_pr12.json": (
+        "tail_ratio", "hedge_rate", "retry_amplification",
+        "interactive_p95", "best_effort_sheds", "expired_on_arrival",
+        "doa_zero_steps",
+    ),
+}
 
 
 def _fmt(v):
@@ -34,7 +55,39 @@ def _fmt(v):
     return str(v)
 
 
-def _row(path):
+def _check_gates(name, d):
+    """Return a list of gate problems for artifact `name` (empty when
+    the artifact is not listed in REQUIRED_GATES or all gates pass)."""
+    required = REQUIRED_GATES.get(name)
+    if not required:
+        return []
+    gates = d.get("gates")
+    if not isinstance(gates, dict):
+        return [f"{name}: no `gates` dict recorded"]
+    problems = []
+    for g in required:
+        rec = gates.get(g)
+        if not isinstance(rec, dict):
+            problems.append(f"{name}: gate `{g}` missing")
+        elif not rec.get("pass"):
+            problems.append(
+                f"{name}: gate `{g}` FAILED "
+                f"({_fmt(rec.get('value'))} not {rec.get('op', '?')} "
+                f"{_fmt(rec.get('bound'))})")
+    return problems
+
+
+def _gate_summary(name, d):
+    """One highlights token summarising the recorded gates."""
+    gates = d.get("gates")
+    if not isinstance(gates, dict) or not gates:
+        return None
+    passed = sum(1 for g in gates.values()
+                 if isinstance(g, dict) and g.get("pass"))
+    return f"gates={passed}/{len(gates)}"
+
+
+def _row(path, problems):
     name = os.path.basename(path)
     m = re.search(r"BENCH_pr(\d+)\.json$", name)
     pr = int(m.group(1)) if m else -1
@@ -42,17 +95,26 @@ def _row(path):
         with open(path) as f:
             d = json.loads(f.readline())
     except (OSError, ValueError) as e:
+        if name in REQUIRED_GATES:
+            problems.append(f"{name}: unreadable, gates unverifiable")
         return (pr, name, "(unreadable)", "-", "-",
                 f"{type(e).__name__}: {e}")
-    hi = "; ".join(f"{k}={_fmt(d[k])}" for k in HIGHLIGHT_KEYS
-                   if d.get(k) is not None)
+    problems.extend(_check_gates(name, d))
+    hi_parts = [f"{k}={_fmt(d[k])}" for k in HIGHLIGHT_KEYS
+                if d.get(k) is not None]
+    gs = _gate_summary(name, d)
+    if gs:
+        hi_parts.append(gs)
     return (pr, name, str(d.get("metric", "?")),
-            _fmt(d.get("value", "?")), str(d.get("unit", "?")), hi)
+            _fmt(d.get("value", "?")), str(d.get("unit", "?")),
+            "; ".join(hi_parts))
 
 
-def report(root=".") -> str:
+def report(root=".", problems=None) -> str:
+    if problems is None:
+        problems = []
     paths = glob.glob(os.path.join(root, "BENCH_pr*.json"))
-    rows = sorted(_row(p) for p in paths)
+    rows = sorted(_row(p, problems) for p in paths)
     lines = ["| PR | artifact | metric | value | unit | highlights |",
              "|---:|----------|--------|------:|------|------------|"]
     for pr, name, metric, value, unit, hi in rows:
@@ -63,5 +125,15 @@ def report(root=".") -> str:
     return "\n".join(lines)
 
 
+def main(argv):
+    problems = []
+    print(report(argv[1] if len(argv) > 1 else ".", problems))
+    if problems:
+        for p in problems:
+            print(f"GATE PROBLEM: {p}", file=sys.stderr)
+        return 1
+    return 0
+
+
 if __name__ == "__main__":
-    print(report(sys.argv[1] if len(sys.argv) > 1 else "."))
+    sys.exit(main(sys.argv))
