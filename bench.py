@@ -1661,6 +1661,252 @@ def bench_tail_smoke(out=None):
     return result
 
 
+def bench_failover_smoke(out=None):
+    """Mid-stream failover proof (PR13, docs/SERVING.md): durable
+    decode sessions survive engine death.  Three legs on local
+    fleets pinned to one checkpoint fingerprint:
+
+      * KILL leg: 3 concurrent 1024-token streams over 2 engines;
+        the engine holding the most live streams is killed once every
+        client has tokens in hand.  Gates: zero client-visible stream
+        failures, zero duplicate and zero missing sequence numbers
+        across all clients (exactly-once), >= 1 spliced terminal, and
+        every spliced stream BIT-IDENTICAL to an uninterrupted
+        reference decode of the same prompt (greedy determinism);
+      * RESUME-FAULT leg: same crash with `serve.resume@0:error`
+        injected — the resume attempt is abandoned and the stream
+        degrades to the pre-failover terminal error (never a hang,
+        never a duplicate token);
+      * WATCHDOG leg: the serving engine goes silent mid-stream
+        (`set_stall`, the engine.stall shape: alive, probing ok,
+        producing nothing) — the per-stream idle watchdog
+        (`stream_idle_s`) fails the stream over and it still finishes
+        bit-identical.
+    `out` writes the JSON line to a file as well
+    (scripts/failover_smoke.sh -> BENCH_pr13.json)."""
+    import tempfile
+    import threading
+
+    import jax
+
+    from singa_tpu.core.net import build_net
+    from singa_tpu.models.transformer import transformer_lm
+    from singa_tpu.serve import EngineFleet, RouterSpec, ServeSpec
+    from singa_tpu.utils.checkpoint import CheckpointManager
+    from singa_tpu.utils.faults import FaultSchedule, inject
+
+    vocab, plen, max_new = 64, 4, 1024
+    seq = 1040                       # net horizon >= plen + max_new
+    cfg = transformer_lm(vocab_size=vocab, num_layers=2, embed_dim=32,
+                         num_heads=4, head_dim=8, seq_len=seq,
+                         batchsize=2)
+    net = build_net(cfg, "kTest",
+                    {"data": {"input": (seq,), "target": (seq,)}})
+    params = net.init_params(jax.random.PRNGKey(0))
+
+    def make_fleet(size, stream_idle_s=0.0):
+        ws = tempfile.mkdtemp(prefix="failover_smoke_")
+        mgr = CheckpointManager(ws, log_fn=lambda s: None)
+        mgr.save(1, params, {"t": np.zeros(())},
+                 health={"verdict": "ok"})
+        spec = ServeSpec(buckets=((2, seq),), max_new_tokens=max_new,
+                         batch_window_s=0.002,
+                         request_timeout_s=120.0, cb="on",
+                         cb_slots=3, cb_block_len=64)
+        rspec = RouterSpec(probe_period_s=0.1, quarantine_after=5,
+                           request_timeout_s=120.0, hedge="off",
+                           stream_idle_s=stream_idle_s)
+        fleet = EngineFleet.local(net, spec, size, workspace=ws,
+                                  params=params, router_spec=rspec,
+                                  log_fn=lambda s: None)
+        fleet.start()
+        return fleet
+
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(1, vocab, size=plen).tolist()
+               for _ in range(3)]
+
+    # -- reference: uninterrupted greedy decode per prompt ------------
+    fleet = make_fleet(1)
+    reference = []
+    for p in prompts:
+        done = None
+        for ev in fleet.generate_stream(p, max_new=max_new,
+                                        timeout=300.0):
+            if ev.get("done"):
+                done = ev
+        reference.append(done["tokens"])
+    fleet.stop()
+
+    def run_streams(fleet, n, mnew, kill_after=None, chaos=None):
+        """n concurrent streams of `mnew` tokens; once EVERY stream
+        has >= kill_after tokens in hand, `chaos(victim)` hits the
+        engine holding the most live streams.  Returns (per-client
+        audits, victim)."""
+        results = [None] * n
+        counts = [0] * n
+        lock = threading.Lock()
+        hit = {"victim": None}
+
+        def strike_when_ready():
+            while True:
+                with lock:
+                    if all(c >= kill_after for c in counts):
+                        break
+                    if all(r is not None for r in results):
+                        return       # finished before chaos armed
+                time.sleep(0.002)
+            by_eng = {}
+            for s in fleet.router.sessions.snapshot()["sessions"]:
+                by_eng[s["engine"]] = by_eng.get(s["engine"], 0) + 1
+            if not by_eng:
+                return
+            victim = max(sorted(by_eng), key=by_eng.get)
+            hit["victim"] = victim
+            chaos(victim)
+
+        def client(k):
+            seen, toks, done, err = [], [], None, None
+            try:
+                for ev in fleet.generate_stream(prompts[k],
+                                                max_new=mnew,
+                                                timeout=300.0):
+                    if ev.get("done"):
+                        done = ev
+                        continue
+                    seen.append(int(ev["i"]))
+                    toks.append(int(ev["token"]))
+                    with lock:
+                        counts[k] += 1
+            except Exception as e:  # noqa: BLE001 — gated below
+                err = f"{type(e).__name__}: {e}"
+            with lock:
+                results[k] = {"seen": seen, "toks": toks,
+                              "done": done, "err": err}
+
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(n)]
+        for t in threads:
+            t.start()
+        if chaos is not None:
+            threading.Thread(target=strike_when_ready,
+                             daemon=True).start()
+        for t in threads:
+            t.join(600.0)
+        if any(r is None for r in results):
+            raise RuntimeError("failover smoke: a client HUNG "
+                               "(stream neither finished nor failed)")
+        return results, hit["victim"]
+
+    def audit(results, mnew):
+        failures = sum(1 for a in results
+                       if a["err"] or a["done"] is None)
+        dup = sum(len(a["seen"]) - len(set(a["seen"]))
+                  for a in results)
+        missing = sum(len(set(range(mnew)) - set(a["seen"]))
+                      for a in results)
+        return failures, dup, missing
+
+    # -- leg 1: kill the engine holding live 1024-token streams -------
+    fleet = make_fleet(2)
+    res, victim = run_streams(
+        fleet, 3, max_new, kill_after=64,
+        chaos=lambda v: fleet.router.handle_for(v).kill())
+    kill_snap = fleet.router.sessions.stats.snapshot()
+    fleet.stop()
+    k_fail, k_dup, k_missing = audit(res, max_new)
+    k_spliced = sum(1 for a in res
+                    if (a["done"] or {}).get("spliced"))
+    k_parity = sum(
+        1 for a, ref in zip(res, reference)
+        if a["toks"] != ref or (a["done"] or {}).get("tokens") != ref)
+
+    # -- leg 2: injected serve.resume fault degrades, never hangs -----
+    fleet = make_fleet(2)
+    with inject(FaultSchedule.parse("serve.resume@0:error")):
+        res_f, _ = run_streams(
+            fleet, 1, 256, kill_after=32,
+            chaos=lambda v: fleet.router.handle_for(v).kill())
+    fault_snap = fleet.router.sessions.stats.snapshot()
+    fleet.stop()
+    f_terminal = int(res_f[0]["err"] is not None
+                     and res_f[0]["done"] is None)
+    _, f_dup, _ = audit(res_f, 256)
+
+    # -- leg 3: silent stall -> idle watchdog -> resume ---------------
+    fleet = make_fleet(2, stream_idle_s=0.5)
+    res_w, _ = run_streams(
+        fleet, 1, 256, kill_after=32,
+        chaos=lambda v: fleet.router.handle_for(v)
+        .engine.set_stall(10.0))
+    watch_snap = fleet.router.sessions.stats.snapshot()
+    fleet.stop()
+    w_fail, w_dup, w_missing = audit(res_w, 256)
+    w_parity = int(res_w[0]["toks"] != reference[0][:256])
+    w_resumed = int(watch_snap["idle_timeouts"] >= 1
+                    and watch_snap["resumed"] >= 1 and not w_fail)
+
+    gates = {
+        "failover_stream_failures": {
+            "value": k_fail, "bound": 0, "op": "==",
+            "pass": bool(k_fail == 0)},
+        "failover_dup_tokens": {
+            "value": k_dup, "bound": 0, "op": "==",
+            "pass": bool(k_dup == 0)},
+        "failover_missing_tokens": {
+            "value": k_missing, "bound": 0, "op": "==",
+            "pass": bool(k_missing == 0)},
+        "failover_spliced_streams": {
+            "value": k_spliced, "bound": 1, "op": ">=",
+            "pass": bool(k_spliced >= 1)},
+        "failover_parity_mismatch": {
+            "value": k_parity, "bound": 0, "op": "==",
+            "pass": bool(k_parity == 0)},
+        "resume_fault_terminal": {
+            "value": f_terminal, "bound": 1, "op": "==",
+            "pass": bool(f_terminal == 1
+                         and fault_snap["resume_faults"] >= 1)},
+        "resume_fault_dup_tokens": {
+            "value": f_dup, "bound": 0, "op": "==",
+            "pass": bool(f_dup == 0)},
+        "idle_watchdog_resumed": {
+            "value": w_resumed, "bound": 1, "op": "==",
+            "pass": bool(w_resumed == 1 and w_dup == 0
+                         and w_missing == 0 and w_parity == 0)},
+    }
+    failures = [f"{k}: {g['value']} not {g['op']} {g['bound']}"
+                for k, g in gates.items() if not g["pass"]]
+    if failures:
+        raise RuntimeError("failover smoke FAILED: "
+                           + "; ".join(failures))
+
+    result = {
+        "metric": "failover_exactly_once_streams",
+        "value": len(res),
+        "unit": "streams",
+        "stream_tokens": max_new,
+        "victim": victim,
+        "kill_leg": {"failures": k_fail, "dup": k_dup,
+                     "missing": k_missing, "spliced": k_spliced,
+                     "parity_mismatch": k_parity,
+                     "sessions": kill_snap},
+        "resume_fault_leg": {"terminal": f_terminal, "dup": f_dup,
+                             "error": res_f[0]["err"],
+                             "sessions": fault_snap},
+        "watchdog_leg": {"failures": w_fail, "dup": w_dup,
+                         "missing": w_missing,
+                         "parity_mismatch": w_parity,
+                         "sessions": watch_snap},
+        "gates": gates,
+        "backend": jax.default_backend(),
+    }
+    line = json.dumps(result)
+    if out:
+        with open(out, "w") as f:
+            f.write(line + "\n")
+    return result
+
+
 def main() -> None:
     if "--cpu-baseline" in sys.argv:
         bench_cpu_baseline()
@@ -1706,6 +1952,12 @@ def main() -> None:
         if "--out" in sys.argv:
             out = sys.argv[sys.argv.index("--out") + 1]
         print(json.dumps(bench_tail_smoke(out=out)))
+        return
+    if "--failover-smoke" in sys.argv:
+        out = None
+        if "--out" in sys.argv:
+            out = sys.argv[sys.argv.index("--out") + 1]
+        print(json.dumps(bench_failover_smoke(out=out)))
         return
     if "--obs-overhead" in sys.argv:
         out = None

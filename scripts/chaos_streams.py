@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Seeded random-fault stream chaos (the nightly chaos.yml leg).
+
+A random-rate `FaultSchedule` over the durable-stream harness: resume
+attempts (`serve.resume`) and dispatches (`fleet.dispatch`) fail at
+seed-chosen rates, and the engine serving stream 0 is killed at a
+seed-derived token offset.  The invariant chaos must never break:
+every stream either finishes with each index delivered exactly once,
+or fails with a TERMINAL error — never a hang, never a duplicate,
+never a sequence gap before the failure.
+
+The seed comes from `FAULT_SEED` (chaos.yml derives it from the UTC
+date, so every night exercises a different interleaving and a red
+night reproduces locally with that day's seed):
+
+    FAULT_SEED=20260805 JAX_PLATFORMS=cpu python scripts/chaos_streams.py
+"""
+
+import os
+import sys
+import tempfile
+import threading
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+from singa_tpu.core.net import build_net  # noqa: E402
+from singa_tpu.models.transformer import transformer_lm  # noqa: E402
+from singa_tpu.serve import (EngineFleet, RouterSpec,  # noqa: E402
+                             ServeSpec)
+from singa_tpu.utils.checkpoint import CheckpointManager  # noqa: E402
+from singa_tpu.utils.faults import FaultSchedule, inject  # noqa: E402
+
+VOCAB, SEQ, MAX_NEW = 64, 272, 256
+
+
+def main() -> int:
+    seed = int(os.environ.get("FAULT_SEED", "0") or "0")
+    rng = np.random.default_rng(seed)
+    cfg = transformer_lm(vocab_size=VOCAB, num_layers=2, embed_dim=32,
+                         num_heads=4, head_dim=8, seq_len=SEQ,
+                         batchsize=2)
+    net = build_net(cfg, "kTest",
+                    {"data": {"input": (SEQ,), "target": (SEQ,)}})
+    params = net.init_params(jax.random.PRNGKey(0))
+    ws = tempfile.mkdtemp(prefix="chaos_streams_")
+    mgr = CheckpointManager(ws, log_fn=lambda s: None)
+    mgr.save(1, params, {"t": np.zeros(())}, health={"verdict": "ok"})
+    spec = ServeSpec(buckets=((2, SEQ),), max_new_tokens=MAX_NEW,
+                     batch_window_s=0.002, request_timeout_s=120.0,
+                     cb="on", cb_slots=3, cb_block_len=16)
+    fleet = EngineFleet.local(
+        net, spec, 3, workspace=ws, params=params,
+        router_spec=RouterSpec(probe_period_s=0.1, quarantine_after=5,
+                               request_timeout_s=120.0, hedge="off"),
+        log_fn=lambda s: None)
+    fleet.start()
+    kill_at = int(rng.integers(8, MAX_NEW // 2))
+    rates = {"serve.resume": float(rng.uniform(0.0, 0.5)),
+             "fleet.dispatch": float(rng.uniform(0.0, 0.05))}
+    sched = FaultSchedule(rates=rates, seed=seed)
+    results = []
+
+    def client(k: int) -> None:
+        prompt = [int(t) for t in rng.integers(1, VOCAB, 4)]
+        seen, outcome = [], None
+        try:
+            for ev in fleet.generate_stream(prompt, max_new=MAX_NEW):
+                if ev.get("done"):
+                    outcome = ("done", ev)
+                    break
+                seen.append(int(ev["i"]))
+                if k == 0 and len(seen) == kill_at:
+                    sess = fleet.router.sessions.snapshot()
+                    victim = sess["sessions"][0]["engine"]
+                    fleet.router.handle_for(victim).kill()
+        except Exception as e:  # noqa: BLE001 — a terminal error is OK
+            outcome = ("error", repr(e))
+        results.append((k, seen, outcome))
+
+    with inject(sched):
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+            assert not t.is_alive(), "CHAOS HANG: a stream is stuck"
+    fleet.stop()
+    for k, seen, outcome in sorted(results):
+        assert outcome is not None, f"stream {k} vanished"
+        assert seen == sorted(set(seen)), \
+            f"stream {k} dup/garbled indices: {seen}"
+        assert seen == list(range(len(seen))), \
+            f"stream {k} gap before failure: {seen}"
+        kind, detail = outcome
+        if kind == "done" and "error" not in detail:
+            assert len(detail.get("tokens", [])) >= len(seen), \
+                f"stream {k} terminal lost tokens"
+        print(f"stream {k}: {kind}, {len(seen)} tokens, "
+              f"{'clean' if kind == 'done' else detail}")
+    counters = {k: v
+                for k, v in fleet.router.sessions.snapshot().items()
+                if k != "sessions"}
+    print(f"seed={seed} kill_at={kill_at} rates={rates} "
+          f"sessions={counters}")
+    print("CHAOS_OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,163 @@
+#!/usr/bin/env bash
+# Failover smoke (ISSUE 13 acceptance): mid-stream failover — durable
+# decode sessions that survive engine death — on CPU.  FAILS unless
+#   * SIGKILL-ing the engine serving >= 3 concurrent 1024-token
+#     streams costs ZERO client-visible stream failures, zero
+#     duplicate and zero missing token indices, and every spliced
+#     output is BIT-IDENTICAL to an uninterrupted run;
+#   * an injected `serve.resume` fault degrades the stream to the
+#     pre-failover terminal error — never a hang, never a duplicate;
+#   * a silently stalled engine (`engine.stall`) is caught by the
+#     per-stream idle watchdog (`stream_idle_s`) and the stream
+#     resumes on a sibling, still bit-identical.
+# Writes BENCH_pr13.json (per-leg session ledgers and a `gates` dict).
+#
+# Usage: scripts/failover_smoke.sh        (CPU-only, no data, ~3 min)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+# Leg 1: the bench smoke — in-process fleets over real engines:
+# kill / resume-fault / watchdog legs.  bench_failover_smoke raises
+# (and this script fails) unless every acceptance bullet holds.
+python bench.py --failover-smoke --out BENCH_pr13.json
+
+# the recorded artifact must actually carry the numbers, not nulls,
+# and every gate it records must have passed
+python - <<'EOF'
+import json
+with open("BENCH_pr13.json") as f:
+    d = json.loads(f.read())
+kl = d["kill_leg"]
+assert kl["failures"] == 0 and kl["dup"] == 0 and kl["missing"] == 0, d
+assert kl["parity_mismatch"] == 0 and kl["spliced"] >= 1, d
+rf = d["resume_fault_leg"]
+assert rf["terminal"] == 1 and rf["dup"] == 0, d
+assert rf["sessions"]["resume_faults"] >= 1, d
+wd = d["watchdog_leg"]
+assert wd["failures"] == 0 and wd["parity_mismatch"] == 0, d
+assert wd["sessions"]["idle_timeouts"] >= 1, d
+gates = d.get("gates")
+assert isinstance(gates, dict) and gates, "gates dict missing"
+bad = [k for k, g in gates.items() if not g.get("pass")]
+assert not bad, f"gates failed: {bad}"
+print(f"BENCH_pr13.json ok: {d['value']} streams x "
+      f"{d['stream_tokens']} tokens survived the kill of "
+      f"{d['victim']} ({kl['spliced']} spliced, 0 dup/missing), "
+      f"resume fault degraded to the old terminal error, watchdog "
+      f"caught the silent stall")
+EOF
+echo "FAILOVER BENCH PASS: the stream outlived its engine, the splice"
+echo "  was exactly-once and bit-identical, the fault degraded honestly"
+
+# Leg 2: the regression suite — exactly-once splice on stubs, stale
+# fingerprint honesty, resume-off / fault / legacy-handle degradation,
+# idle watchdog, drain-kick of a resumed stream, scheduler-level
+# resume admission (fast 400 at zero engine steps), transport-budget
+# deadline clamp.
+python -m pytest tests/test_failover.py -q -m failover -p no:cacheprovider
+
+# Leg 3: the subprocess deployment — 2 real `serve --pinned` worker
+# processes (same conf + seed -> same fingerprint), a stream killed by
+# a REAL SIGKILL mid-decode, spliced bit-identically onto the sibling.
+python - <<'EOF'
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+PORTS = [18481, 18482]
+SPEC = ("buckets=2x128,max_new_tokens=48,batch_window_s=0.005,"
+        "cb=on,cb_slots=2,cb_block_len=16")
+
+
+def spawn(port):
+    return subprocess.Popen(
+        [sys.executable, "-m", "singa_tpu.main", "serve",
+         "-model_conf", "examples/transformer/lm.conf",
+         "--pinned", "--port", str(port), "--serve_spec", SPEC],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def wait_ready(port, deadline_s=300):
+    deadline = time.time() + deadline_s
+    while True:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=2)
+            return
+        except Exception:
+            if time.time() > deadline:
+                raise RuntimeError(f"worker on :{port} never came up")
+            time.sleep(0.25)
+
+
+procs = {p: spawn(p) for p in PORTS}
+try:
+    for p in PORTS:
+        wait_ready(p)
+    hostfile = tempfile.NamedTemporaryFile(
+        mode="w", suffix=".hosts", delete=False)
+    hostfile.write("".join(f"127.0.0.1:{p}\n" for p in PORTS))
+    hostfile.close()
+
+    from singa_tpu.serve import EngineFleet, RouterSpec
+    fleet = EngineFleet.from_hostfile(
+        hostfile.name,
+        router_spec=RouterSpec(probe_period_s=0.1, quarantine_after=2,
+                               request_timeout_s=120.0, hedge="off"),
+        log_fn=lambda s: None)
+    fleet.start()
+    prompt = [5, 7, 9, 11]
+
+    # reference: an uninterrupted stream (same fingerprint everywhere,
+    # so WHICH worker serves it does not matter)
+    ref = None
+    for ev in fleet.generate_stream(prompt, max_new=48):
+        if ev.get("done"):
+            assert "error" not in ev, ev
+            ref = ev["tokens"]
+    assert ref is not None and len(ref) >= 16, ref
+
+    # the failover stream: SIGKILL the worker actually serving it
+    # after 8 delivered tokens — a REAL process death mid-decode
+    seen, done = [], None
+    for ev in fleet.generate_stream(prompt, max_new=48):
+        if ev.get("done"):
+            done = ev
+            break
+        seen.append((ev["i"], ev["token"]))
+        if len(seen) == 8:
+            sess = fleet.router.sessions.snapshot()["sessions"][0]
+            victim = PORTS[int(sess["engine"].split("-")[1])]
+            procs[victim].send_signal(signal.SIGKILL)
+            procs[victim].wait()
+    assert done is not None and "error" not in done, done
+    idx = [i for i, _ in seen]
+    assert idx == list(range(len(ref))), f"dup/missing indices: {idx}"
+    assert [t for _, t in seen] == ref, "streamed tokens != reference"
+    assert done["tokens"] == ref, "spliced terminal != reference"
+    assert done.get("spliced") is True and done.get("resumes", 0) >= 1
+    snap = fleet.router.sessions.snapshot()
+    assert snap["resumed"] >= 1 and snap["failed"] == 0, snap
+    fleet.stop()
+    print(f"subprocess failover ok: SIGKILL of :{victim} mid-stream, "
+          f"{len(ref)} tokens delivered exactly once, splice "
+          f"bit-identical (resumes={done['resumes']})")
+finally:
+    for pr in procs.values():
+        if pr.poll() is None:
+            pr.kill()
+EOF
+echo "FAILOVER SUBPROCESS PASS: a real worker SIGKILL mid-stream,"
+echo "  spliced bit-identically onto the surviving sibling"
+
+# Leg 4: the report — BENCH_pr13.json lands in the table and its
+# recorded gates are checked (missing/failing gates exit non-zero).
+python tools/bench_report.py | grep -E 'BENCH_pr13' > /dev/null || {
+    echo "BENCH REPORT LEG FAILED"; exit 1; }
+python tools/bench_report.py
+echo "FAILOVER SMOKE PASS"

@@ -31,6 +31,12 @@ checkpoints via atomic hot-reload.  Layers:
                  dispatch, retry-on-other-engine (streams: only
                  before the first byte), Backoff quarantine /
                  readmission, router-level shedding
+    session.py   StreamSession + SessionManager: the durable decode
+                 session journal behind mid-stream failover — every
+                 emitted token recorded with an absolute sequence
+                 number, resume-as-prefill on a same-fingerprint
+                 sibling, at-most-once splice, idle-watchdog and
+                 drain-kick triggers, singa_stream_* counters
     fleet.py     EngineFleet + RolloutController + FleetServer:
                  N workers behind one router, canary rollout with
                  auto-rollback, streaming passthrough, elastic
@@ -53,8 +59,9 @@ checkpoints via atomic hot-reload.  Layers:
 
 Fault sites `serve.admit` / `serve.batch` / `serve.reload` /
 `fleet.dispatch` / `fleet.rollout` / `scale.decide` / `serve.hedge` /
-`engine.stall` (utils.faults) make every degradation path — hedged
-tail-cutting included — deterministic on CPU.
+`engine.stall` / `serve.resume` (utils.faults) make every degradation
+path — hedged tail-cutting and mid-stream failover included —
+deterministic on CPU.
 """
 
 from . import qos
@@ -70,10 +77,11 @@ from .router import (EngineUnavailable, HttpEngineHandle,
                      RouterStats)
 from .scheduler import ContinuousScheduler, StreamTicket
 from .server import InferenceServer
+from .session import SessionManager, StreamSession, StreamStats
 from .stats import ServeStats
 from .qos import PRIORITIES, ClassBackoffs, RetryBudget
-from .traffic import (Phase, TrafficGen, diurnal, flash_crowd, ramp,
-                      stall_chaos, steady)
+from .traffic import (Phase, TrafficGen, diurnal, flash_crowd,
+                      kill_chaos, ramp, stall_chaos, steady)
 
 __all__ = ["AutoScaler", "AutoScaleSpec", "Cancelled",
            "ClassBackoffs", "ContinuousScheduler", "DeadlineExpired",
@@ -82,6 +90,7 @@ __all__ = ["AutoScaler", "AutoScaleSpec", "Cancelled",
            "LocalEngineHandle", "MicroBatcher", "Overloaded",
            "PRIORITIES", "PagedKVCache", "Phase", "RetryBudget",
            "RolloutController", "RolloutSpec", "Router", "RouterSpec",
-           "RouterStats", "ServeSpec", "ServeStats", "StreamTicket",
-           "Ticket", "TrafficGen", "diurnal", "flash_crowd", "qos",
-           "ramp", "stall_chaos", "steady"]
+           "RouterStats", "ServeSpec", "ServeStats", "SessionManager",
+           "StreamSession", "StreamStats", "StreamTicket", "Ticket",
+           "TrafficGen", "diurnal", "flash_crowd", "kill_chaos",
+           "qos", "ramp", "stall_chaos", "steady"]

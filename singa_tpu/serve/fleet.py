@@ -679,6 +679,9 @@ class FleetServer:
         self.log = log_fn
         self.metrics = MetricsRegistry()
         self.fleet.router.stats.register_into(self.metrics)
+        # durable-stream session counters (singa_stream_*): failover /
+        # splice / dedupe visibility next to the fleet counters
+        self.fleet.router.sessions.stats.register_into(self.metrics)
         self._host, self._port = host, port
         self._httpd = None
         self._http_thread: Optional[threading.Thread] = None

@@ -83,6 +83,25 @@ def remaining_s(deadline: Optional[float]) -> Optional[float]:
     return deadline - time.monotonic()
 
 
+def transport_budget(deadline: Optional[float],
+                     timeout: Optional[float],
+                     default_s: float,
+                     slack_s: float = 30.0) -> float:
+    """Socket/wait budget for one transport hop: base time plus
+    dispatch slack.  With an end-to-end deadline the slack is CLAMPED
+    to the remaining budget (floor 0.1 s) — a flat `+ 30.0` would let
+    a socket outlive a 2 s client deadline by 30 s, holding the
+    connection (and the engine slot behind it) long after the client
+    gave up.  Without a deadline the old generous slack stands: there
+    is no client budget to leak past."""
+    rem = remaining_s(deadline)
+    if rem is not None:
+        base = max(rem, 0.1)
+        return base + min(float(slack_s), base)
+    base = timeout if timeout and timeout > 0 else default_s
+    return max(float(base), 0.1) + float(slack_s)
+
+
 def deadline_to_header(deadline: Optional[float]) -> Optional[str]:
     """Remaining-budget milliseconds for `X-Deadline-Ms` (floored at 0
     so a dead request still propagates as dead, not as no-deadline)."""

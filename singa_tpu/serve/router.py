@@ -62,12 +62,20 @@ from .. import obs
 from ..utils import faults
 from . import qos
 from .batcher import Cancelled, DeadlineExpired, Overloaded
+from .session import SessionManager
 
 
 class EngineUnavailable(RuntimeError):
     """The chosen engine could not take the request at all (process
     dead, connection refused, handler crashed) — retried on another
     engine and charged to this one as a strike."""
+
+
+class _FailoverStale(RuntimeError):
+    """No engine pinned to the session's fingerprint remains, but
+    OTHER fingerprints are serving — resuming there would break
+    bit-determinism, so the stream terminates honestly with
+    `finish: "failover_stale"` instead of splicing a lie."""
 
 
 @dataclass(frozen=True)
@@ -88,6 +96,13 @@ class RouterSpec:
     retry_budget_burst: float = 16.0  # token-bucket cap
     brownout_shed_rate: float = 0.1   # capacity-shed rate engaging
                                       # brownout (0 = never)
+    resume: str = "on"             # mid-stream failover: resume a
+                                   # journaled stream on a sibling
+                                   # ("off" = pre-PR terminal errors)
+    stream_idle_s: float = 0.0     # per-stream idle watchdog: no
+                                   # token for this long -> failover
+                                   # (0 = off; catches engine.stall-
+                                   # style silent stragglers)
 
     def __post_init__(self):
         if int(self.quarantine_after) < 1:
@@ -105,6 +120,12 @@ class RouterSpec:
         if float(self.retry_budget_ratio) < 0 or \
                 float(self.retry_budget_burst) < 0:
             raise ValueError("retry budget ratio/burst must be >= 0")
+        if str(self.resume) not in ("on", "off"):
+            raise ValueError(f"resume must be on|off, got "
+                             f"{self.resume!r}")
+        if float(self.stream_idle_s) < 0:
+            raise ValueError(f"stream_idle_s must be >= 0, got "
+                             f"{self.stream_idle_s}")
 
     @classmethod
     def parse(cls, spec: Optional[str]) -> "RouterSpec":
@@ -225,35 +246,36 @@ class LocalEngineHandle:
                        max_new: Optional[int] = None,
                        deadline: Optional[float] = None,
                        priority: str = "interactive",
-                       cancel_event: Optional[threading.Event] = None):
+                       cancel_event: Optional[threading.Event] = None,
+                       resume_from: int = 0):
         """Streaming generate (cb engines only).  Admission happens
         HERE, before any event is yielded — the router's commit point
         for retry-on-other-engine.  Returns an iterator of ndjson-
-        shaped dicts: {"token": t} per token, then the final
-        {"done": True, ...} summary."""
+        shaped dicts: {"token": t, "i": n} per token (n the absolute
+        sequence number, resume_from-based for a failover
+        re-admission), then the final {"done": True, ...} summary."""
         if not self._alive:
             raise EngineUnavailable(f"engine {self.name} is down")
         try:
             ticket = self.server.generate_stream(
                 tokens, timeout=timeout, max_new=max_new,
                 deadline=deadline, priority=priority,
-                cancel_event=cancel_event)
+                cancel_event=cancel_event, resume_from=resume_from)
         except (Overloaded, DeadlineExpired, TimeoutError, ValueError,
                 Cancelled):
             raise
         except Exception as e:  # noqa: BLE001 — no cb / stopped
             raise EngineUnavailable(
                 f"engine {self.name} cannot stream: {e}") from e
-        rem = qos.remaining_s(deadline)
-        budget = max(rem if rem is not None
-                     else timeout if timeout and timeout > 0
-                     else self.engine.spec.request_timeout_s,
-                     0.1) + 30.0
+        budget = qos.transport_budget(
+            deadline, timeout, self.engine.spec.request_timeout_s)
 
         def gen():
+            i = ticket.first_index
             for kind, payload in ticket.events(timeout=budget):
                 if kind == "tok":
-                    yield {"token": payload}
+                    yield {"token": payload, "i": i}
+                    i += 1
                 else:
                     out = dict(payload)
                     out["done"] = True
@@ -351,23 +373,24 @@ class HttpEngineHandle:
         payload = {"tokens": [int(t) for t in toks]}
         if timeout is not None:
             payload["timeout"] = timeout
-        rem = qos.remaining_s(deadline)
-        budget = max(rem if rem is not None
-                     else timeout or self.connect_timeout_s,
-                     0.1) + 30.0
+        budget = qos.transport_budget(deadline, timeout,
+                                      self.connect_timeout_s)
         return self._call("POST", f"/{mode}", payload, timeout=budget,
                           headers=self._qos_headers(deadline, priority))
 
     def request_stream(self, tokens, timeout: Optional[float] = None,
                        max_new: Optional[int] = None,
                        deadline: Optional[float] = None,
-                       priority: Optional[str] = None):
+                       priority: Optional[str] = None,
+                       resume_from: int = 0):
         """Streaming generate over HTTP: POST {"stream": true} and
         decode the chunked ndjson line-by-line WITHOUT buffering the
         body.  The response status is the commit point: admission
         errors surface as mapped exceptions before any line is
         yielded; after that a transport failure is a mid-stream
-        RuntimeError (not retriable — tokens already flowed)."""
+        RuntimeError — which the router's session layer now catches
+        and RESUMES on a sibling engine (`resume_from` carries the
+        journaled-prefix length on a re-admission)."""
         toks = (tokens.tolist() if isinstance(tokens, np.ndarray)
                 else list(tokens))
         payload: Dict[str, Any] = {"tokens": [int(t) for t in toks],
@@ -376,10 +399,10 @@ class HttpEngineHandle:
             payload["timeout"] = timeout
         if max_new is not None:
             payload["max_new"] = int(max_new)
-        rem = qos.remaining_s(deadline)
-        budget = max(rem if rem is not None
-                     else timeout or self.connect_timeout_s,
-                     0.1) + 30.0
+        if int(resume_from) > 0:
+            payload["resume_from"] = int(resume_from)
+        budget = qos.transport_budget(deadline, timeout,
+                                      self.connect_timeout_s)
         hdrs = {"Content-Type": "application/json"}
         hdrs.update(self._qos_headers(deadline, priority))
         req = urllib.request.Request(
@@ -639,6 +662,9 @@ class Router:
         self.retry_budget = qos.RetryBudget(
             ratio=self.spec.retry_budget_ratio,
             burst=self.spec.retry_budget_burst)
+        # durable stream sessions: the journal mid-stream failover
+        # resumes from (serve/session.py)
+        self.sessions = SessionManager()
         # cached control signals (recomputed at most every 0.5s: the
         # deques behind windowed() are too big for the hot path)
         self._hedge_cache: float = float(self.spec.hedge_max_s)
@@ -739,6 +765,14 @@ class Router:
                     drained = False
                     break
                 time.sleep(0.005)
+        if not drained:
+            # the engine is leaving whether its streams finished or
+            # not: fail every live session over to a sibling so
+            # scale-down never truncates a journaled stream
+            kicked = self.sessions.kick_engine(name, "drain timeout")
+            if kicked:
+                self.log(f"fleet: drain of {name} timed out with "
+                         f"{kicked} live stream(s); failing them over")
         with self._lock:
             self._members.pop(name, None)
         self.stats.count("retires")
@@ -1118,7 +1152,8 @@ class Router:
             self._shed(why, priority=priority)
 
     def _call_stream(self, name: str, tokens, timeout, max_new,
-                     deadline, priority, cancel_event):
+                     deadline, priority, cancel_event,
+                     resume_from: int = 0):
         with self._lock:
             m = self._members.get(name)
         if m is None:
@@ -1128,7 +1163,8 @@ class Router:
             m.handle.request_stream, (tokens,),
             {"timeout": timeout, "max_new": max_new,
              "deadline": deadline, "priority": priority,
-             "cancel_event": cancel_event})
+             "cancel_event": cancel_event,
+             "resume_from": resume_from})
 
     def _hedged_stream(self, name: str, tokens, timeout, max_new,
                        deadline, priority) -> tuple:
@@ -1136,8 +1172,10 @@ class Router:
         attempt admits its stream and pulls one event; whichever
         event lands first commits that engine, the loser's
         cancel_event tears its slot down mid-decode.  Returns
-        (winner, first_event, generator) with the winner's in-flight
-        slot STILL HELD (released by `_wrap_stream`)."""
+        (winner, first_event, generator, cancel_event) with the
+        winner's in-flight slot STILL HELD (released by the session
+        stream wrapper); the cancel_event is the failover path's
+        lever for tearing down a stalled winner."""
         resq: "queue.Queue" = queue.Queue()
         sel = threading.Lock()
         state = {"done": False}
@@ -1188,7 +1226,7 @@ class Router:
             ename, kind, payload = resq.get_nowait()
             if kind == "err":
                 raise payload
-            return ename, payload[0], payload[1]
+            return ename, payload[0], payload[1], cancels[ename]
 
         launch(name, "fleet.dispatch")
         pending = {name}
@@ -1231,7 +1269,7 @@ class Router:
                     ev.set()
             if winner == hedge_name:
                 self.stats.count("hedge_wins")
-            return winner, first, gen
+            return winner, first, gen, cancels[winner]
         exc = excs.get(name)
         if exc is None and excs:
             exc = next(iter(excs.values()))
@@ -1290,7 +1328,7 @@ class Router:
                 break
             tried.add(name)
             try:
-                winner, first, gen = self._hedged_stream(
+                winner, first, gen, cancel = self._hedged_stream(
                     name, tokens, timeout, max_new, deadline,
                     priority)
             except Overloaded as e:
@@ -1308,10 +1346,17 @@ class Router:
                 last_exc = e
                 self.stats.count("retried")
                 continue
-            # committed to this engine: wrap the stream so the
-            # in-flight accounting survives however the consumer
-            # finishes (exhaustion, error, or abandonment)
-            return self._wrap_stream(winner, first, gen, t0, priority)
+            # committed to this engine: open the durable session —
+            # the journal + leg pump that let the stream survive the
+            # engine (docs/SERVING.md, "Mid-stream failover")
+            session = self.sessions.open(
+                prompt=tokens, max_new=max_new, deadline=deadline,
+                priority=priority, engine=winner,
+                step=self.engine_step(winner))
+            leg = _StreamLeg(self, session, winner, gen, cancel,
+                             first=first)
+            return self._session_stream(session, leg, t0, priority,
+                                        timeout)
         if budget_stopped and last_exc is not None:
             if isinstance(last_exc, Overloaded):
                 self.stats.observe_shed(priority)
@@ -1327,37 +1372,267 @@ class Router:
                f"all {len(tried)} reachable engine(s) failed")
         self._shed(why, priority=priority)
 
-    def _wrap_stream(self, name: str, first, stream, t0: float,
-                     priority: str = "interactive"):
-        with self._lock:
-            m = self._members.get(name)
+    def _session_stream(self, session, leg, t0: float, priority: str,
+                        timeout: Optional[float]):
+        """Consumer loop of a durable stream: journals every token by
+        absolute sequence number, dedupes the splice (each index
+        reaches the client AT MOST once), arms the per-stream idle
+        watchdog, and on any leg death — transport break, silent
+        stall, sequence gap, drain-timeout kick — swaps in a resume
+        leg from `_failover_leg`.  The client iterator only learns a
+        leg died when resume itself is impossible."""
+        sstats = self.sessions.stats
+        idle = float(self.spec.stream_idle_s)
+        state = "failed"
+        finished = False
 
-        def events():
-            yield first
-            for ev in stream:
+        def terminal(ev):
+            """Splice the terminal event: the FULL token list from
+            the journal (a resumed leg's own `tokens` is only its
+            suffix), marked `spliced` when any failover happened."""
+            out = dict(ev)
+            out["engine"] = session.engine
+            if session.emitted or "tokens" in out:
+                out["tokens"] = list(session.emitted)
+            if session.resumes:
+                out["spliced"] = True
+                out["resumes"] = session.resumes
+                sstats.count("spliced")
+                obs.emit_event("stream.spliced", sid=session.sid,
+                               engine=session.engine,
+                               resumes=session.resumes,
+                               tokens=len(session.emitted))
+            return out
+
+        try:
+            while True:
+                try:
+                    entry = session.q.get(
+                        timeout=idle if idle > 0 else None)
+                except queue.Empty:
+                    sstats.count("idle_timeouts")
+                    leg = self._failover_leg(session, leg, TimeoutError(
+                        f"stream idle > {idle:.3f}s on engine "
+                        f"{session.engine} (silent stall)"), timeout)
+                    if leg is None:
+                        break
+                    continue
+                src, kind, payload = entry
+                if src is None:           # drain-timeout kick
+                    leg = self._failover_leg(
+                        session, leg, EngineUnavailable(
+                            f"engine {session.engine} retiring "
+                            f"mid-stream: {payload}"), timeout)
+                    if leg is None:
+                        break
+                    continue
+                if src is not leg:
+                    # a zombie leg woke up after failover: its tokens
+                    # are already journaled (or being re-derived by
+                    # the resume leg) and its control events describe
+                    # a leg we abandoned — drop everything
+                    if kind == "ev" and not payload.get("done"):
+                        sstats.count("dup_tokens")
+                    continue
+                if kind in ("err", "end"):
+                    err = (payload if kind == "err" else
+                           EngineUnavailable(
+                               f"engine {session.engine} stream ended "
+                               f"without a terminal event"))
+                    leg = self._failover_leg(session, leg, err,
+                                             timeout)
+                    if leg is None:
+                        break
+                    continue
+                ev = payload
+                if ev.get("done"):
+                    state = "spliced" if session.resumes else "done"
+                    finished = True
+                    yield terminal(ev)
+                    return
+                i = int(ev.get("i", session.next_i))
+                if i < session.next_i:
+                    sstats.count("dup_tokens")
+                    continue
+                if i > session.next_i:
+                    sstats.count("gap_events")
+                    leg = self._failover_leg(
+                        session, leg, RuntimeError(
+                            f"sequence gap on {session.engine}: "
+                            f"expected index {session.next_i}, "
+                            f"got {i}"), timeout)
+                    if leg is None:
+                        break
+                    continue
+                session.record(ev["token"])
                 yield ev
+            # _failover_leg returned None: the journal already holds
+            # every token (the leg died between its last token and
+            # its terminal event) — synthesize the done honestly
+            state, finished = "spliced", True
+            yield terminal({"done": True, "finish": "length",
+                            "step": session.step})
+        except _FailoverStale as e:
+            # no same-fingerprint engine remains: an honest terminal
+            # with the journaled prefix, never a cross-checkpoint lie
+            state, finished = "failover_stale", True
+            yield {"done": True, "finish": "failover_stale",
+                   "engine": session.engine, "step": session.step,
+                   "tokens": list(session.emitted),
+                   "resumes": session.resumes, "error": str(e)}
+        finally:
+            if leg is not None:
+                (leg.release if finished else leg.abandon)()
+            self.sessions.close(session, state)
+            if finished:
+                with self._lock:
+                    m = self._members.get(session.engine)
+                    if m is not None:
+                        m.dispatched += 1
+                self._shed_backoffs.reset(priority)
+                self.stats.count("completed")
+                self.stats.observe_latency(time.monotonic() - t0,
+                                           priority)
+            else:
+                self.stats.count("failed")
 
-        def gen():
-            finished = False
+    def _failover_leg(self, session, old_leg, err, timeout):
+        """Replace a dead stream leg: re-admit (prompt ‖ emitted
+        prefix) as fresh prefill on a sibling pinned to the SAME
+        checkpoint fingerprint, continuing from the next owed index —
+        sound because greedy decode is bit-deterministic given
+        (fingerprint, prompt, tokens-so-far).  Raises `_FailoverStale`
+        when only other fingerprints remain, and otherwise degrades
+        to `err` — the pre-failover terminal error — whenever resume
+        is off, denied (budget/deadline), faulted (`serve.resume`),
+        or inadmissible: failover can never turn a crash into a hang
+        or a duplicate.  Returns the new leg, or None when the
+        journal is already complete."""
+        sstats = self.sessions.stats
+        old_engine = session.engine
+        old_leg.abandon()
+        sstats.count("failovers")
+        session.resumes += 1
+        session.state = "failed_over"
+        with self._lock:
+            m = self._members.get(old_engine)
+            draining = m is None or m.draining
+        if not draining:
+            # a deliberate retirement is not the engine's fault; a
+            # mid-stream death is
+            self._strike(old_engine, f"stream leg failed: {err}")
+        if self.spec.resume != "on":
+            raise err
+        rem = qos.remaining_s(session.deadline)
+        if rem is not None and rem <= 0:
+            sstats.count("resume_denied")
+            self.stats.count("deadline_terminal")
+            raise DeadlineExpired(
+                f"stream leg died ({err}) with deadline already "
+                f"exhausted") from err
+        try:
+            # one resume attempt per visit: an injected failure
+            # abandons the resume and the stream degrades to the
+            # pre-failover terminal error
+            faults.maybe_fault("serve.resume")
+        except Exception:  # noqa: BLE001 — injected fault
+            sstats.count("resume_faults")
+            raise err
+        if session.max_new is not None and \
+                session.next_i >= session.max_new:
+            return None               # journal already complete
+        tried = {old_engine}
+        while True:
+            if not self.retry_budget.spend():
+                sstats.count("resume_denied")
+                self.stats.count("budget_denied")
+                raise err
+            name, other_steps = self._pick_resume(tried, session.step)
+            if name is None:
+                self.retry_budget.refund()
+                if other_steps:
+                    raise _FailoverStale(
+                        f"no engine pinned to step {session.step} "
+                        f"remains (siblings serve a different "
+                        f"fingerprint); refusing to splice across "
+                        f"checkpoints") from err
+                sstats.count("resume_denied")
+                raise err
+            tried.add(name)
+            with self._lock:
+                mem = self._members.get(name)
+            if mem is not None:
+                acc = _accepted_kwargs(mem.handle.request_stream)
+                if acc is not None and "resume_from" not in acc:
+                    # a handle that silently dropped resume_from
+                    # would replay the stream from index 0 — degrade
+                    # instead of splicing garbage
+                    self._release(name)
+                    self.retry_budget.refund()
+                    sstats.count("resume_denied")
+                    raise err
+            cancel = threading.Event()
+            at = session.next_i
             try:
-                for ev in events():
-                    if ev.get("done"):
-                        ev.setdefault("engine", name)
-                        finished = True
-                    yield ev
-            finally:
+                self.stats.count("attempts")
+                gen = self._call_stream(
+                    name, session.resume_tokens(), timeout,
+                    session.max_new, session.deadline,
+                    session.priority, cancel, resume_from=at)
+                first = next(gen)
+            except Overloaded:
                 self._release(name)
-                if finished:
-                    with self._lock:
-                        if m is not None:
-                            m.dispatched += 1
-                    self._shed_backoffs.reset(priority)
-                    self.stats.count("completed")
-                    self.stats.observe_latency(time.monotonic() - t0,
-                                               priority)
-                else:
-                    self.stats.count("failed")
-        return gen()
+                continue              # saturated sibling: try another
+            except ValueError as e:
+                self._release(name)
+                sstats.count("resume_denied")
+                raise err from e      # inadmissible resume: degrade
+            except DeadlineExpired as e:
+                self._release(name)
+                self.stats.count("deadline_terminal")
+                raise e from err
+            except StopIteration:
+                self._release(name)
+                continue
+            except BaseException as e:  # noqa: BLE001 — engine died
+                self._release(name)
+                with self._lock:
+                    mm = self._members.get(name)
+                    if mm is not None:
+                        mm.failed += 1
+                self._strike(name, f"resume dispatch failed: {e}")
+                continue
+            session.engine = name
+            sstats.count("resumed")
+            obs.emit_event("stream.resume", sid=session.sid,
+                           from_engine=old_engine, engine=name,
+                           at=at, resumes=session.resumes,
+                           why=str(err))
+            self.log(f"fleet: stream {session.sid} resumed on "
+                     f"{name} from token {at} ({err})")
+            return _StreamLeg(self, session, name, gen, cancel,
+                              first=first)
+
+    def _pick_resume(self, exclude: set, step: int):
+        """Least-loaded healthy engine pinned to `step` (in-flight
+        slot taken), or (None, whether engines at OTHER steps exist)
+        — the caller's stale-vs-degrade decision."""
+        with self._lock:
+            cands = []
+            other_steps = False
+            for n, m in self._members.items():
+                if (n in exclude or not m.healthy or m.quarantined
+                        or m.draining):
+                    continue
+                if int(m.step) != int(step):
+                    other_steps = True
+                    continue
+                cands.append((m.in_flight + m.queue_depth, n))
+            if not cands:
+                return None, other_steps
+            _, name = min(cands)
+            self._members[name].in_flight += 1
+            return name, other_steps
 
     def _shed(self, why: str, priority: str = "interactive",
               brownout: bool = False) -> None:
@@ -1385,4 +1660,56 @@ class Router:
         out = self.stats.snapshot()
         out["engines"] = self.members()
         out["healthy_engines"] = len(self.healthy_names())
+        out["streams"] = self.sessions.snapshot()
         return out
+
+
+class _StreamLeg:
+    """One engine-side transport attempt of a durable stream: a pump
+    thread drains the handle's event iterator into the session's ONE
+    queue tagged with this leg's identity, and the leg owns exactly
+    one in-flight slot on its engine until `release()` (idempotent).
+    `abandon()` is the failover teardown — cancel the engine-side
+    decode, close the iterator, give back the slot; the pump may stay
+    blocked inside the iterator (a zombie), but its late writes carry
+    this leg's tag and the session consumer drops them."""
+
+    def __init__(self, router, session, engine: str, gen, cancel,
+                 first=None):
+        self.router = router
+        self.session = session
+        self.engine = engine
+        self.gen = gen
+        self.cancel = cancel
+        self._first = first
+        self._released = False
+        self._rel_lock = threading.Lock()
+        threading.Thread(
+            target=self._pump,
+            name=f"leg-{session.sid}-{engine}", daemon=True).start()
+
+    def _pump(self) -> None:
+        q = self.session.q
+        try:
+            if self._first is not None:
+                q.put((self, "ev", self._first))
+            for ev in self.gen:
+                q.put((self, "ev", ev))
+            q.put((self, "end", None))
+        except BaseException as e:  # noqa: BLE001 — leg death = event
+            q.put((self, "err", e))
+
+    def release(self) -> None:
+        with self._rel_lock:
+            if self._released:
+                return
+            self._released = True
+        self.router._release(self.engine)
+
+    def abandon(self) -> None:
+        self.cancel.set()
+        try:
+            self.gen.close()
+        except Exception:  # noqa: BLE001 — pump mid-next(): harmless
+            pass
+        self.release()

@@ -70,8 +70,15 @@ class StreamTicket:
     as they are produced (`events()` / `tokens()`), and `wait()`
     blocks for the final result dict exactly like `Ticket.wait`."""
 
-    def __init__(self, corr: Optional[str] = None):
+    def __init__(self, corr: Optional[str] = None,
+                 first_index: int = 0):
         self.corr = corr
+        # absolute sequence number of the FIRST token this ticket will
+        # emit: 0 for a fresh stream, `resume_from` for a failover
+        # re-admission — the k-th emitted token is index
+        # first_index + k, so both legs of a spliced stream number
+        # consistently and the router can dedupe by index
+        self.first_index = int(first_index)
         self._q: "queue.Queue" = queue.Queue()
         self._done = threading.Event()
         self._result: Optional[Dict[str, Any]] = None
@@ -228,8 +235,8 @@ class ContinuousScheduler:
                max_new: Optional[int] = None,
                deadline: Optional[float] = None,
                priority: str = "interactive",
-               cancel_event: Optional[threading.Event] = None
-               ) -> StreamTicket:
+               cancel_event: Optional[threading.Event] = None,
+               resume_from: int = 0) -> StreamTicket:
         """Admit one generate request.  `max_new` caps this request's
         generation (clamped to spec.max_new_tokens).  `deadline`
         (absolute monotonic; wins over `timeout`) is the request's
@@ -240,7 +247,19 @@ class ContinuousScheduler:
         `cancelled`).  Raises ValueError for a never-servable prompt
         or unknown priority (fail fast, the HTTP layer's 400),
         `Overloaded` when the pending queue is full or brownout sheds
-        this class."""
+        this class.
+
+        `resume_from=n` re-admits a failed-over stream: `tokens` is
+        (original prompt ‖ the n tokens already emitted), the fresh
+        prefill re-derives the continuation (greedy decode is
+        bit-deterministic given fingerprint + prefix, the PR 8 parity
+        property), and the ticket numbers its output from absolute
+        index n so the router can splice and dedupe.  Only
+        max_new - n MORE tokens are generated and the block
+        reservation covers exactly (grown prompt + remainder).  A
+        resume past `max_new` or past an already-emitted EOS is a
+        fast 400 (counted `rejected`, zero engine steps) — the
+        original stream was already complete."""
         spec = self.spec
         arr = np.asarray(tokens, np.int32).reshape(-1)
         if arr.size < 1:
@@ -257,11 +276,31 @@ class ContinuousScheduler:
             self.stats.count("rejected")
             raise ValueError(f"max_new must be >= 1, got {mn}")
         mn = min(mn, int(spec.max_new_tokens))
-        try:
-            priority = qos.check_priority(priority)
-        except ValueError:
+        resume_from = int(resume_from)
+        if resume_from < 0:
             self.stats.count("rejected")
-            raise
+            raise ValueError(f"resume_from must be >= 0, got "
+                             f"{resume_from}")
+        if resume_from > 0:
+            if resume_from >= mn:
+                self.stats.count("rejected")
+                raise ValueError(
+                    f"resume_from {resume_from} is past max_new {mn}; "
+                    f"the stream already completed")
+            if resume_from > arr.size:
+                self.stats.count("rejected")
+                raise ValueError(
+                    f"resume_from {resume_from} exceeds the "
+                    f"{arr.size}-token prompt+prefix")
+            if spec.eos_id is not None and \
+                    np.any(arr[-resume_from:] == int(spec.eos_id)):
+                self.stats.count("rejected")
+                raise ValueError(
+                    f"resume_from {resume_from} is past EOS: the "
+                    f"emitted prefix already contains eos_id "
+                    f"{spec.eos_id}")
+            mn = mn - resume_from     # only the remainder decodes
+            self.stats.count("resumed")
         nblocks = -(-(int(arr.size) + mn) // int(spec.cb_block_len))
         deadline = qos.resolve_deadline(timeout, deadline,
                                         spec.request_timeout_s)
@@ -275,7 +314,9 @@ class ContinuousScheduler:
                 f"{now - deadline:.3f}s before admission")
         corr = f"cbreq-{next(self._req_ids)}"
         req = _CBRequest(tokens=arr, plen=int(arr.size), max_new=mn,
-                         nblocks=nblocks, ticket=StreamTicket(corr),
+                         nblocks=nblocks,
+                         ticket=StreamTicket(corr,
+                                             first_index=resume_from),
                          t_submit=now, deadline=deadline, corr=corr,
                          priority=priority, cancel_event=cancel_event)
         with obs.span("scheduler.admit", corr=corr,

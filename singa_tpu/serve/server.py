@@ -206,19 +206,22 @@ class InferenceServer:
                         max_new: Optional[int] = None,
                         deadline: Optional[float] = None,
                         priority: str = "interactive",
-                        cancel_event: Optional[threading.Event] = None
-                        ) -> StreamTicket:
+                        cancel_event: Optional[threading.Event] = None,
+                        resume_from: int = 0) -> StreamTicket:
         """Streaming admission (cb only): returns the request's
         `StreamTicket` — iterate `.tokens()` / `.events()` for tokens
-        as slots produce them.  Raises RuntimeError when the server
-        is not running continuous batching."""
+        as slots produce them.  `resume_from=n` re-admits a failover
+        continuation: the last n prompt tokens are an already-emitted
+        prefix, the ticket numbers its output from n.  Raises
+        RuntimeError when the server is not running continuous
+        batching."""
         if self.scheduler is None:
             raise RuntimeError("streaming generate needs cb=on in the "
                                "serve spec")
         return self.scheduler.submit(
             tokens, timeout=timeout, max_new=max_new,
             deadline=deadline, priority=priority,
-            cancel_event=cancel_event)
+            cancel_event=cancel_event, resume_from=resume_from)
 
     def predict(self, tokens,
                 timeout: Optional[float] = None,
@@ -238,16 +241,13 @@ class InferenceServer:
 
     def _wait_budget(self, timeout: Optional[float],
                      deadline: Optional[float] = None) -> float:
-        # queue deadline + generous dispatch slack: wait() must outlive
-        # the in-queue deadline so expiry surfaces as DeadlineExpired,
-        # not a bare TimeoutError.  An explicit absolute deadline wins
-        # (its remaining budget IS the queue bound).
-        rem = qos.remaining_s(deadline)
-        if rem is not None:
-            return max(rem, 0.1) + 30.0
-        base = (timeout if timeout and timeout > 0
-                else self.engine.spec.request_timeout_s)
-        return max(base, 0.1) + 30.0
+        # queue deadline + dispatch slack: wait() must outlive the
+        # in-queue deadline so expiry surfaces as DeadlineExpired, not
+        # a bare TimeoutError.  qos.transport_budget clamps the slack
+        # to the remaining deadline so the wait can't outlive the
+        # client's budget by a flat 30s.
+        return qos.transport_budget(
+            deadline, timeout, self.engine.spec.request_timeout_s)
 
     def snapshot(self) -> Dict[str, Any]:
         out = self.stats.snapshot()
@@ -332,8 +332,10 @@ def _make_handler(server: InferenceServer):
                         max_new = int(max_new)
                     if req.get("stream") and \
                             server.scheduler is not None:
-                        self._stream_generate(tokens, timeout, max_new,
-                                              deadline, priority)
+                        self._stream_generate(
+                            tokens, timeout, max_new, deadline,
+                            priority,
+                            resume_from=int(req.get("resume_from", 0)))
                         return
                     out = server.generate(tokens, timeout=timeout,
                                           max_new=max_new,
@@ -360,11 +362,14 @@ def _make_handler(server: InferenceServer):
                              + data + b"\r\n")
 
         def _stream_generate(self, tokens, timeout, max_new,
-                             deadline=None,
-                             priority="interactive") -> None:
-            """Chunked-transfer ndjson: one {"token": t} line per
-            produced token as the slot produces it, then a final
-            {"done": true, ...} summary line.  Admission errors raise
+                             deadline=None, priority="interactive",
+                             resume_from=0) -> None:
+            """Chunked-transfer ndjson: one {"token": t, "i": n} line
+            per produced token as the slot produces it (n the absolute
+            sequence number — resume_from-based for a failover
+            re-admission; old clients simply ignore the extra key),
+            then a final {"done": true, ...} summary line.  Admission
+            errors — including an inadmissible resume_from — raise
             BEFORE any byte is sent and take the normal status-code
             path in do_POST; a mid-stream failure becomes a terminal
             {"error": ...} line (the 200 is already on the wire)."""
@@ -372,16 +377,19 @@ def _make_handler(server: InferenceServer):
             ticket = server.scheduler.submit(tokens, timeout=timeout,
                                              max_new=max_new,
                                              deadline=deadline,
-                                             priority=priority)
+                                             priority=priority,
+                                             resume_from=resume_from)
             self.send_response(200)
             self.send_header("Content-Type", "application/x-ndjson")
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
+            i = ticket.first_index
             try:
                 for kind, payload in ticket.events(
                         timeout=server._wait_budget(timeout, deadline)):
                     if kind == "tok":
-                        line = {"token": payload}
+                        line = {"token": payload, "i": i}
+                        i += 1
                     else:
                         line = dict(payload)
                         line["done"] = True

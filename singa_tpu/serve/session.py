@@ -1,0 +1,200 @@
+"""Router-side durable decode sessions: the journal that makes a
+stream survive engine death (docs/SERVING.md, "Mid-stream failover").
+
+A stream's transport must not be its unit of failure ("RPC Considered
+Harmful", arxiv 1805.08430): once tokens have flowed, the old commit
+point turned every engine crash, silent stall, or drain-timeout into a
+mid-stream RuntimeError on exactly the long, expensive streams.  The
+state worth keeping alive is tiny and lives HERE, one hop above the
+engines: the prompt, every emitted token with an absolute sequence
+number, the serving fingerprint (checkpoint step), and the QoS
+envelope (deadline / priority / max_new).
+
+That journal is sufficient to resume because greedy decode is
+bit-deterministic given (fingerprint, prompt, tokens-so-far) — the
+same property the paged==contiguous parity rig proved.  On failover
+the router re-admits (prompt ‖ emitted-prefix) as a fresh prefill on a
+*different* engine pinned to the same fingerprint with
+`resume_from=n`; the new leg numbers its tokens from n, and the
+consumer loop dedupes by sequence number so the client sees every
+index exactly once — at-most-once delivery, bit-identical to the
+uninterrupted stream.
+
+Lifecycle (the JOURNALED → FAILED-OVER → SPLICED arc in SERVING.md):
+
+    JOURNALED    every active stream; tokens recorded as they pass
+    FAILED-OVER  a leg died (transport break, idle watchdog,
+                 drain-timeout kick) and a resume leg was admitted
+    SPLICED      the resumed leg finished; the terminal `done` event
+                 carries the FULL token list and `spliced: true`
+    DONE/FAILED  terminal either way; `failover_stale` is the honest
+                 terminal finish when no same-fingerprint engine
+                 remains to resume onto
+
+Every leg writes into its session's ONE event queue tagged with its
+leg identity; a stalled old leg that wakes up after failover can only
+produce already-journaled indices (dropped by the dedupe, counted
+`dup_tokens`) or stale control events (ignored: wrong leg tag) — a
+zombie leg can never corrupt the client stream.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+STREAM_STATES = ("journaled", "failed_over", "spliced", "done",
+                 "failed", "failover_stale")
+
+
+class StreamStats:
+    """Fleet-wide stream-session counters, exported as
+    `singa_stream_*` (RouterStats mold, failover edition)."""
+
+    FIELDS = ("opened", "done", "failed", "failovers", "resumed",
+              "spliced", "dup_tokens", "gap_events", "idle_timeouts",
+              "kicked", "resume_faults", "resume_denied",
+              "failover_stale")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        for f in self.FIELDS:
+            setattr(self, f, 0)
+
+    def count(self, fieldname: str, n: int = 1) -> None:
+        with self._lock:
+            # getattr validates the field exactly like ServeStats.gauge
+            setattr(self, fieldname, getattr(self, fieldname) + n)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {f: getattr(self, f) for f in self.FIELDS}
+
+    def register_into(self, registry,
+                      prefix: str = "singa_stream") -> None:
+        from ..obs.metrics import Sample
+
+        def collect():
+            snap = self.snapshot()
+            return [Sample(f"{prefix}_{k}_total", "counter",
+                           f"stream session counter {k!r}",
+                           float(snap[k])) for k in self.FIELDS]
+
+        registry.register_collector(collect)
+
+
+class StreamSession:
+    """One stream's durable state: everything needed to re-derive the
+    continuation on another engine, and the dedupe cursor that makes
+    the splice at-most-once."""
+
+    def __init__(self, sid: str, prompt: np.ndarray,
+                 max_new: Optional[int], deadline: Optional[float],
+                 priority: str, engine: str, step: int):
+        self.sid = sid
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new = (int(max_new) if max_new is not None else None)
+        self.deadline = deadline
+        self.priority = priority
+        self.engine = engine          # current leg's engine
+        self.step = int(step)         # serving fingerprint (ckpt step)
+        self.emitted: List[int] = []  # the journal: token i at [i]
+        self.next_i = 0               # dedupe cursor: next index owed
+        self.resumes = 0
+        self.state = "journaled"
+        self.t0 = time.monotonic()
+        # ONE queue for the session's whole life; every leg pumps into
+        # it tagged with its leg object, kicks are tagged None — see
+        # module docstring for why a zombie leg is harmless
+        self.q: "queue.Queue" = queue.Queue()
+
+    def record(self, token: int) -> None:
+        """Journal token `next_i` (caller already deduped by index)."""
+        self.emitted.append(int(token))
+        self.next_i += 1
+
+    def resume_tokens(self) -> np.ndarray:
+        """The re-admission prompt: original prompt ‖ emitted prefix —
+        with the fingerprint, the complete decode state."""
+        if not self.emitted:
+            return self.prompt
+        return np.concatenate(
+            [self.prompt, np.asarray(self.emitted, np.int32)])
+
+    def kick(self, why: str) -> None:
+        """Ask the consumer loop to fail over NOW (drain-timeout
+        during scale-down): delivered through the session queue so it
+        interrupts even a consumer parked waiting for the next
+        token."""
+        self.q.put((None, "kick", why))
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"sid": self.sid, "engine": self.engine,
+                "step": self.step, "state": self.state,
+                "emitted": len(self.emitted),
+                "resumes": self.resumes,
+                "age_s": round(time.monotonic() - self.t0, 3)}
+
+
+class SessionManager:
+    """The router's registry of live stream sessions: opens/closes
+    them, owns the `singa_stream_*` stats, and fans a drain-timeout
+    kick out to every session still on the doomed engine."""
+
+    def __init__(self):
+        self.stats = StreamStats()
+        self._lock = threading.Lock()
+        self._sessions: Dict[str, StreamSession] = {}
+        self._ids = itertools.count(1)
+
+    def open(self, prompt, max_new: Optional[int],
+             deadline: Optional[float], priority: str,
+             engine: str, step: int) -> StreamSession:
+        sid = f"stream-{next(self._ids)}"
+        s = StreamSession(sid, prompt, max_new, deadline, priority,
+                          engine, step)
+        with self._lock:
+            self._sessions[sid] = s
+        self.stats.count("opened")
+        return s
+
+    def close(self, session: StreamSession, state: str) -> None:
+        session.state = state
+        with self._lock:
+            self._sessions.pop(session.sid, None)
+        if state in ("done", "spliced"):
+            self.stats.count("done")
+        elif state == "failover_stale":
+            self.stats.count("failover_stale")
+        else:
+            self.stats.count("failed")
+
+    def kick_engine(self, engine: str, why: str) -> int:
+        """Fail every live session on `engine` over to a sibling
+        (scale-down drain timed out: the engine is leaving whether its
+        streams finished or not).  Returns how many were kicked."""
+        with self._lock:
+            doomed = [s for s in self._sessions.values()
+                      if s.engine == engine]
+        for s in doomed:
+            s.kick(why)
+        if doomed:
+            self.stats.count("kicked", len(doomed))
+        return len(doomed)
+
+    def active(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            sessions = [s.snapshot() for s in self._sessions.values()]
+        out: Dict[str, Any] = dict(self.stats.snapshot())
+        out["active"] = len(sessions)
+        out["sessions"] = sessions
+        return out

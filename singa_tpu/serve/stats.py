@@ -89,6 +89,8 @@ class ServeStats:
         self.shed_batch = 0
         self.shed_best_effort = 0
         self.rejected = 0        # never-servable request (fast 400)
+        self.resumed = 0         # admissions that re-entered with a
+                                 # resume_from prefix (stream failover)
         self.queue_depth = 0     # gauge: requests waiting right now
         self.generated_tokens = 0
         # continuous batching (serve/scheduler.py)
@@ -308,7 +310,7 @@ class ServeStats:
         counters = ("submitted", "completed", "failed", "expired",
                     "expired_on_arrival", "cancelled", "shed",
                     "shed_interactive", "shed_batch",
-                    "shed_best_effort", "rejected",
+                    "shed_best_effort", "rejected", "resumed",
                     "generated_tokens", "batches",
                     "batched_requests", "batch_slots", "cb_steps",
                     "compiles", "reloads", "reload_failures",
@@ -359,6 +361,7 @@ class ServeStats:
                 "shed_batch": self.shed_batch,
                 "shed_best_effort": self.shed_best_effort,
                 "rejected": self.rejected,
+                "resumed": self.resumed,
                 "queue_depth": self.queue_depth,
                 "generated_tokens": self.generated_tokens,
                 "batches": self.batches,

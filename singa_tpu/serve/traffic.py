@@ -135,6 +135,12 @@ class _PhaseLog:
         self.completed_by_class: Dict[str, int] = {}
         self.shed_by_class: Dict[str, int] = {}
         self.lat_by_class: Dict[str, List[float]] = {}
+        # per-stream delivery audit (the failover exactly-once gate):
+        # duplicate/out-of-order indices and spliced terminals seen by
+        # the CLIENT side of the harness
+        self.stream_resumed = 0
+        self.stream_dup = 0
+        self.stream_gap = 0
 
 
 def stall_chaos(fleet, name: Optional[str] = None,
@@ -160,6 +166,38 @@ def stall_chaos(fleet, name: Optional[str] = None,
             raise RuntimeError(f"stall_chaos: {target!r} is not a "
                                f"local engine (no set_stall)")
         eng.set_stall(stall_s)
+    return hook
+
+
+def kill_chaos(fleet, name: Optional[str] = None,
+               delay_s: float = 0.0) -> Callable[[], None]:
+    """Chaos `on_start` hook: crash one LOCAL engine
+    (`LocalEngineHandle.kill`) — the mid-stream failover leg runs
+    against this.  With `name=None` the lexicographically FIRST
+    active member dies (the Router's least-loaded tie-break prefers
+    earlier names, so the victim is holding live streams when it
+    goes).  `delay_s` arms the kill on a timer so streams admitted at
+    phase start are mid-decode when it fires."""
+    def hook():
+        target = name
+        if target is None:
+            members = sorted(m["name"]
+                             for m in fleet.router.members()
+                             if not m.get("draining"))
+            target = members[0] if members else None
+        if target is None:
+            return
+
+        def kill():
+            h = fleet.router.handle_for(target)
+            if not hasattr(h, "kill"):
+                raise RuntimeError(f"kill_chaos: {target!r} has no "
+                                   f"kill() (not a local handle)")
+            h.kill()
+        if delay_s > 0:
+            threading.Timer(float(delay_s), kill).start()
+        else:
+            kill()
     return hook
 
 
@@ -223,7 +261,22 @@ class TrafficGen:
         t0 = time.monotonic()
         try:
             if as_stream:
+                want_i = 0
                 for ev in self.stream_fn(tokens, max_new=mnew, **kw):
+                    if "token" in ev and not ev.get("done"):
+                        i = int(ev.get("i", want_i))
+                        if i < want_i:
+                            with self._lock:
+                                log.stream_dup += 1
+                        elif i > want_i:
+                            with self._lock:
+                                log.stream_gap += 1
+                            want_i = i + 1
+                        else:
+                            want_i += 1
+                    elif ev.get("done") and ev.get("spliced"):
+                        with self._lock:
+                            log.stream_resumed += 1
                     if phase.slow_reader_s > 0 and "token" in ev:
                         time.sleep(phase.slow_reader_s)
             else:
@@ -361,6 +414,9 @@ class TrafficGen:
                     "p50_ms": self._quantile(lats, 0.50),
                     "p95_ms": self._quantile(lats, 0.95),
                     "p99_ms": self._quantile(lats, 0.99),
+                    "stream_resumed": log.stream_resumed,
+                    "stream_dup": log.stream_dup,
+                    "stream_gap": log.stream_gap,
                     "by_class": self._by_class(log),
                     "errors": list(log.errors),
                 }
@@ -370,6 +426,9 @@ class TrafficGen:
             tot.shed += log.shed
             tot.failed += log.failed
             tot.dropped_harness += log.dropped_harness
+            tot.stream_resumed += log.stream_resumed
+            tot.stream_dup += log.stream_dup
+            tot.stream_gap += log.stream_gap
             tot.latencies.extend(lats)
             tot.errors.extend(log.errors)
             with self._lock:
@@ -395,6 +454,9 @@ class TrafficGen:
                 "p50_ms": self._quantile(tot.latencies, 0.50),
                 "p95_ms": self._quantile(tot.latencies, 0.95),
                 "p99_ms": self._quantile(tot.latencies, 0.99),
+                "stream_resumed": tot.stream_resumed,
+                "stream_dup": tot.stream_dup,
+                "stream_gap": tot.stream_gap,
                 "by_class": self._by_class(tot),
                 "errors": tot.errors[:10],
             },

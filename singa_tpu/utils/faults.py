@@ -82,6 +82,12 @@ restart — so a one-shot fault never re-fires during recovery):
                    obs write path swallows the fault into a drop
                    counter, proving telemetry failure never takes
                    down training or serving)
+    serve.resume   one mid-stream failover resume attempt
+                   (Router._failover_leg — an error abandons the
+                   resume and the stream degrades to the pre-failover
+                   terminal error: the client sees exactly the old
+                   mid-stream RuntimeError, never a hang and never a
+                   duplicated token)
 
 Fault kinds:
 
@@ -123,7 +129,7 @@ SITES = ("data.decode", "data.prefetch", "feed.stage", "ckpt.save",
          "step.grad", "serve.admit", "serve.batch", "serve.reload",
          "serve.hedge", "engine.stall", "fleet.dispatch",
          "fleet.rollout", "pipeline.publish", "scale.decide",
-         "obs.emit")
+         "obs.emit", "serve.resume")
 
 KINDS = ("error", "preempt", "corrupt", "torn", "nan", "spike",
          "stall")

@@ -46,6 +46,12 @@ REQUIRED_GATES = {
         "interactive_p95", "best_effort_sheds", "expired_on_arrival",
         "doa_zero_steps",
     ),
+    "BENCH_pr13.json": (
+        "failover_stream_failures", "failover_dup_tokens",
+        "failover_missing_tokens", "failover_spliced_streams",
+        "failover_parity_mismatch", "resume_fault_terminal",
+        "resume_fault_dup_tokens", "idle_watchdog_resumed",
+    ),
 }
 
 
