@@ -1907,6 +1907,230 @@ def bench_failover_smoke(out=None):
     return result
 
 
+def bench_trace_smoke(out=None):
+    """ISSUE 14 acceptance (docs/OBSERVABILITY.md): fleet-wide
+    distributed tracing.  Three legs:
+
+      * TRACE leg: a 3-engine local fleet with hedging forced
+        (hedge_min_s = hedge_max_s = 1ms) serves one hedged unary
+        request and one stream whose engine is KILLED mid-stream
+        (failover resume).  The merged trace must show, PER request,
+        exactly ONE trace id across every leg (primary + hedge +
+        resume), spans from >= 2 engines on the failed-over stream,
+        zero orphan spans, and per-stage attribution
+        (admit/dispatch/first_token/decode) summing within 10% of the
+        end-to-end latency;
+      * FLIGHTREC leg: a fresh fleet with NO trace export — only the
+        flight recorder armed — suffers the same mid-stream kill; the
+        `stream.resume` trigger must dump the last events to
+        `flightrec-failover-*.json` (post-mortem without tracing
+        pre-enabled);
+      * OVERHEAD leg: tracing-on must stay under the PR-6 < 3% wall
+        gate (`bench_obs_overhead`, 2 interleaved reps).
+    `out` writes the JSON line to a file as well
+    (scripts/obs_smoke.sh -> BENCH_pr14.json)."""
+    import glob
+    import tempfile
+    import threading
+
+    import jax
+
+    from singa_tpu import obs
+    from singa_tpu.core.net import build_net
+    from singa_tpu.models.transformer import transformer_lm
+    from singa_tpu.obs import collect
+    from singa_tpu.serve import EngineFleet, RouterSpec, ServeSpec
+    from singa_tpu.utils.checkpoint import CheckpointManager
+
+    vocab, plen, max_new = 64, 4, 256
+    seq = 272                        # net horizon >= plen + max_new
+    cfg = transformer_lm(vocab_size=vocab, num_layers=2, embed_dim=32,
+                         num_heads=4, head_dim=8, seq_len=seq,
+                         batchsize=2)
+    net = build_net(cfg, "kTest",
+                    {"data": {"input": (seq,), "target": (seq,)}})
+    params = net.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(14)
+    prompt = rng.integers(1, vocab, size=plen).tolist()
+
+    def make_fleet(size):
+        ws = tempfile.mkdtemp(prefix="trace_smoke_")
+        mgr = CheckpointManager(ws, log_fn=lambda s: None)
+        mgr.save(1, params, {"t": np.zeros(())},
+                 health={"verdict": "ok"})
+        spec = ServeSpec(buckets=((2, seq),), max_new_tokens=max_new,
+                         batch_window_s=0.002,
+                         request_timeout_s=120.0, cb="on",
+                         cb_slots=3, cb_block_len=64)
+        rspec = RouterSpec(probe_period_s=0.1, quarantine_after=5,
+                           request_timeout_s=120.0, hedge="on",
+                           hedge_min_s=0.001, hedge_max_s=0.001)
+        fleet = EngineFleet.local(net, spec, size, workspace=ws,
+                                  params=params, router_spec=rspec,
+                                  log_fn=lambda s: None)
+        fleet.start()
+        return fleet
+
+    def killed_stream(fleet, kill_after=32):
+        """One stream; once `kill_after` tokens are in hand, kill the
+        engine holding the session — forces a mid-stream failover."""
+        count = {"n": 0}
+        lock = threading.Lock()
+
+        def strike():
+            while True:
+                with lock:
+                    if count["n"] >= kill_after:
+                        break
+                    if count["n"] < 0:
+                        return
+                time.sleep(0.002)
+            sess = fleet.router.sessions.snapshot()["sessions"]
+            if sess:
+                fleet.router.handle_for(sess[0]["engine"]).kill()
+
+        threading.Thread(target=strike, daemon=True).start()
+        done = None
+        for ev in fleet.generate_stream(prompt, max_new=max_new,
+                                        timeout=300.0):
+            if ev.get("done"):
+                done = ev
+            else:
+                with lock:
+                    count["n"] += 1
+        with lock:
+            count["n"] = -1
+        return done
+
+    # -- leg 1: hedged unary + killed stream, trace everything --------
+    tmp = tempfile.mkdtemp(prefix="trace_smoke_obs_")
+    with obs.session(obs.ObsSpec(
+            trace=os.path.join(tmp, "trace.json"),
+            process="router", trace_ring=65536)):
+        fleet = make_fleet(3)
+        try:
+            fleet.generate(prompt, timeout=300.0)
+            done = killed_stream(fleet)
+            reqs = fleet.router.requests.snapshot()["recent"]
+            merged = collect.merge([obs.trace_dump()])
+        finally:
+            fleet.stop()
+    if done is None or not (done.get("spliced") or done.get("done")):
+        raise RuntimeError("trace smoke: killed stream never finished")
+
+    # unary rows finish "ok"; stream rows finish "done" or (after a
+    # failover) "spliced" — anything else is a failed request
+    rows = {r["mode"]: r for r in reqs
+            if r.get("outcome") in ("ok", "done", "spliced")}
+    u_row, s_row = rows.get("generate"), rows.get("stream")
+    if u_row is None or s_row is None:
+        raise RuntimeError(f"trace smoke: missing request rows "
+                           f"({sorted(rows)})")
+
+    def span_args(pred):
+        return [e["args"] for e in merged["traceEvents"]
+                if e.get("ph") == "X" and pred(e)]
+
+    # one trace id per request: every span tagged with a request's
+    # corr must carry that request's trace id and no other
+    ids_per_req = max(
+        len({a.get("trace") for a in span_args(
+            lambda e: e["args"].get("corr") == r["corr"])})
+        for r in (u_row, s_row))
+    s_spans = collect.spans_of(merged, s_row["trace"])
+    s_names = {e["name"] for e in s_spans}
+    resume_in_trace = int("router.resume" in s_names
+                          and "stream.decode" in s_names
+                          and "router.stream" in s_names)
+    s_engines = {e["args"].get("engine") for e in s_spans
+                 if e["args"].get("engine")}
+    h_legs = sum(1 for e in collect.spans_of(merged, u_row["trace"])
+                 if e["name"] == "router.attempt")
+    n_orphans = len(collect.orphans(merged))
+    stage_err = max(
+        abs(1.0 - sum(r["stages_ms"].values())
+            / max(r["latency_ms"], 1e-9))
+        for r in (u_row, s_row))
+    timeline = collect.critical_path(merged, s_row["trace"])
+
+    # -- leg 2: flight recorder WITHOUT tracing pre-enabled -----------
+    fr_dir = tempfile.mkdtemp(prefix="trace_smoke_fr_")
+    with obs.session(obs.ObsSpec(flightrec=fr_dir)):
+        fleet = make_fleet(2)
+        try:
+            killed_stream(fleet)
+        finally:
+            fleet.stop()
+        dumps = sorted(glob.glob(
+            os.path.join(fr_dir, "flightrec-failover-*.json")))
+    fr_replayed = 0
+    if dumps:
+        with open(dumps[-1]) as f:
+            fr_replayed = int("stream.resume" in f.read())
+
+    # -- leg 3: tracing-on overhead under the PR-6 gate ---------------
+    over = bench_obs_overhead(reps=2)
+
+    gates = {
+        "trace_ids_per_request": {
+            "value": ids_per_req, "bound": 1, "op": "==",
+            "pass": bool(ids_per_req == 1)},
+        "trace_resume_in_trace": {
+            "value": resume_in_trace, "bound": 1, "op": "==",
+            "pass": bool(resume_in_trace == 1)},
+        "trace_hedge_legs": {
+            "value": h_legs, "bound": 2, "op": ">=",
+            "pass": bool(h_legs >= 2)},
+        "trace_engines_spanned": {
+            "value": len(s_engines), "bound": 2, "op": ">=",
+            "pass": bool(len(s_engines) >= 2)},
+        "trace_orphan_spans": {
+            "value": n_orphans, "bound": 0, "op": "==",
+            "pass": bool(n_orphans == 0)},
+        "stage_attribution_err": {
+            "value": round(stage_err, 4), "bound": 0.10, "op": "<",
+            "pass": bool(stage_err < 0.10)},
+        "flightrec_replayed": {
+            "value": fr_replayed, "bound": 1, "op": "==",
+            "pass": bool(fr_replayed == 1)},
+        "trace_overhead": {
+            "value": over["value"], "bound": 0.03, "op": "<",
+            "pass": bool(over["value"] < 0.03)},
+    }
+    failures = [f"{k}: {g['value']} not {g['op']} {g['bound']}"
+                for k, g in gates.items() if not g["pass"]]
+    if failures:
+        raise RuntimeError("trace smoke FAILED: "
+                           + "; ".join(failures))
+
+    result = {
+        "metric": "trace_smoke_merged_trace",
+        "value": ids_per_req,
+        "unit": "trace_ids_per_request",
+        "stream": {"trace": s_row["trace"],
+                   "latency_ms": s_row["latency_ms"],
+                   "stages_ms": s_row["stages_ms"],
+                   "resumes": s_row.get("resumes"),
+                   "engines": sorted(s_engines),
+                   "spans": len(s_spans)},
+        "hedged_unary": {"trace": u_row["trace"],
+                         "latency_ms": u_row["latency_ms"],
+                         "stages_ms": u_row["stages_ms"],
+                         "hedged": u_row.get("hedged"),
+                         "attempt_legs": h_legs},
+        "critical_path_head": timeline[:5],
+        "flightrec_dumps": len(dumps),
+        "obs_overhead": over["value"],
+        "gates": gates,
+        "backend": jax.default_backend(),
+    }
+    line = json.dumps(result)
+    if out:
+        with open(out, "w") as f:
+            f.write(line + "\n")
+    return result
+
+
 def main() -> None:
     if "--cpu-baseline" in sys.argv:
         bench_cpu_baseline()
@@ -1958,6 +2182,12 @@ def main() -> None:
         if "--out" in sys.argv:
             out = sys.argv[sys.argv.index("--out") + 1]
         print(json.dumps(bench_failover_smoke(out=out)))
+        return
+    if "--trace-smoke" in sys.argv:
+        out = None
+        if "--out" in sys.argv:
+            out = sys.argv[sys.argv.index("--out") + 1]
+        print(json.dumps(bench_trace_smoke(out=out)))
         return
     if "--obs-overhead" in sys.argv:
         out = None

@@ -5,8 +5,11 @@
 # checkpoint / feeder / batcher / engine with matching correlation
 # ids, (2) a JSONL event log carrying the injected fault's recovery
 # events, and (3) on the serve leg a /metrics endpoint that parses as
-# Prometheus text exposition and agrees with /stats.  Finishes with
-# the obs-overhead A/B gate (< 3%) -> BENCH_pr6.json.
+# Prometheus text exposition and agrees with /stats.  The ISSUE 14
+# leg proves DISTRIBUTED tracing: a subprocess worker's /trace ring
+# merged with the router's buffer yields one trace id across both
+# processes with zero orphan spans.  Finishes with the obs-overhead
+# A/B gate (< 3%) -> BENCH_pr6.json.
 #
 # Usage: scripts/obs_smoke.sh        (CPU-only, no data, ~2 min)
 set -euo pipefail
@@ -199,7 +202,99 @@ print("OBS SERVE LEG PASS: req->batch->engine correlated;",
       "/metrics == /stats on", sorted(metrics)[:3], "...")
 EOF
 
-# Leg 4: the overhead gate — --obs on must cost < 3% wall time on the
+# Leg 4 (ISSUE 14): distributed tracing across REAL process
+# boundaries — a subprocess `serve --pinned` worker with its span
+# ring on GET /trace, an in-process router session sending one
+# request with the X-Trace-Id/X-Parent-Span pair, and obs.collect
+# merging both buffers into ONE trace: worker spans must carry the
+# router's trace id, with zero orphan spans, and the text timeline
+# tool must render the merged file.
+python - <<'EOF'
+import json
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+PORT = 18491
+SPEC = ("buckets=2x128,max_new_tokens=16,batch_window_s=0.005,"
+        "cb=on,cb_slots=2,cb_block_len=16")
+tmp = tempfile.mkdtemp(prefix="obs_smoke_dist_")
+
+proc = subprocess.Popen(
+    [sys.executable, "-m", "singa_tpu.main", "serve",
+     "-model_conf", "examples/transformer/lm.conf",
+     "--pinned", "--port", str(PORT), "--serve_spec", SPEC,
+     "--workspace", tmp, "--obs", "on",
+     "--obs_spec", "trace_ring=65536,process=worker-0"],
+    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+try:
+    deadline = time.time() + 300
+    while True:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{PORT}/healthz", timeout=2)
+            break
+        except Exception:
+            if time.time() > deadline:
+                raise RuntimeError("worker never came up")
+            time.sleep(0.25)
+
+    from singa_tpu import obs
+    from singa_tpu.obs import collect
+    from singa_tpu.serve import EngineFleet, RouterSpec
+
+    with obs.session(obs.ObsSpec(process="router",
+                                 trace_ring=65536)):
+        fleet = EngineFleet.adopt(
+            [f"http://127.0.0.1:{PORT}"],
+            router_spec=RouterSpec(probe_period_s=0.1,
+                                   quarantine_after=2,
+                                   request_timeout_s=120.0,
+                                   hedge="off"),
+            log_fn=lambda s: None)
+        fleet.start()
+        out = fleet.generate([5, 7, 9, 11], timeout=120.0)
+        assert out.get("tokens"), out
+        row = fleet.router.requests.snapshot()["recent"][-1]
+        trace_id = row["trace"]
+        assert trace_id, row
+        router_buf = obs.trace_dump()
+        fleet.stop()
+
+    worker_buf = collect.fetch_trace(f"http://127.0.0.1:{PORT}")
+    merged = collect.merge([router_buf, worker_buf])
+    spans = collect.spans_of(merged, trace_id)
+    pids = {e["pid"] for e in spans}
+    assert len(pids) >= 2, \
+        f"trace {trace_id} did not cross the process boundary: {pids}"
+    procs = set(merged.get("processes", {}).values())
+    assert {"router", "worker-0"} <= procs, procs
+    names = {e["name"] for e in spans}
+    assert "router.dispatch" in names and "serve.request" in names, \
+        names
+    bad = collect.orphans(merged, trace_id)
+    assert not bad, f"orphan spans: {[e['name'] for e in bad]}"
+
+    merged_path = f"{tmp}/merged.json"
+    with open(merged_path, "w") as f:
+        json.dump(merged, f)
+    txt = subprocess.run(
+        [sys.executable, "tools/trace_timeline.py", merged_path,
+         "--trace", trace_id],
+        capture_output=True, text=True, timeout=60)
+    assert txt.returncode == 0 and "critical path" in txt.stdout, \
+        txt.stdout + txt.stderr
+    print(f"OBS DIST LEG PASS: trace {trace_id} spans "
+          f"{sorted(procs)} with zero orphans "
+          f"({len(spans)} span(s) merged)")
+finally:
+    proc.kill()
+    proc.wait(30)
+EOF
+
+# Leg 5: the overhead gate — --obs on must cost < 3% wall time on the
 # chunked LeNet loop (bench_obs_overhead raises nothing; the JSON
 # carries the verdict we assert here).
 python bench.py --obs-overhead --out BENCH_pr6.json > /dev/null

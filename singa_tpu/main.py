@@ -128,7 +128,10 @@ def _add_obs_flags(ap: argparse.ArgumentParser) -> None:
                     help="telemetry config: comma-separated key=value "
                          "over the ObsSpec fields, e.g. "
                          "'trace=/tmp/t.json,events=/tmp/e.jsonl,"
-                         "metrics_period_s=5,max_spans=100000' "
+                         "metrics_period_s=5,max_spans=100000,"
+                         "trace_ring=65536,max_events_mb=64,"
+                         "process=worker-0,sample=tail,"
+                         "sample_slow_ms=250,flightrec=/tmp/fr' "
                          "(singa_tpu/obs/__init__.py)")
 
 
@@ -149,6 +152,10 @@ def _obs_enable(args, workspace=None) -> bool:
         spec.trace = os.path.join(base, "trace.json")
     if not spec.events:
         spec.events = os.path.join(base, "events.jsonl")
+    if not spec.flightrec:
+        # post-mortem flight recorder armed by default: triggered
+        # dumps land next to the other obs artifacts
+        spec.flightrec = os.path.join(base, "flightrec")
     obs.enable(spec)
     return True
 

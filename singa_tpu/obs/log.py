@@ -33,17 +33,41 @@ LEVELS = ("debug", "info", "warning", "error")
 
 
 class EventLog:
-    """Append-only JSONL event sink; see module docstring."""
+    """Append-only JSONL event sink; see module docstring.
 
-    def __init__(self, path: str):
+    `max_bytes > 0` bounds the file: when the next line would cross
+    the bound, the current file rotates to `<path>.1` (one previous
+    generation, overwritten each rotation — disk stays under ~2x the
+    bound for a week-long pipeline run) and a fresh file is opened.
+    The `written`/`dropped` counters are CUMULATIVE across rotations:
+    the flush accounting (`obs.flush` event) must keep adding up no
+    matter how many times the file rolled underneath it."""
+
+    def __init__(self, path: str, max_bytes: int = 0):
         import os
         self.path = path
+        self.max_bytes = max(int(max_bytes or 0), 0)
         self._lock = threading.Lock()
         self.written = 0
         self.dropped = 0
+        self.rotations = 0
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         self._f: Optional[TextIO] = open(path, "a")
+        try:
+            self._size = os.path.getsize(path)
+        except OSError:
+            self._size = 0
+
+    def _rotate_locked(self) -> None:
+        """Roll the live file to `<path>.1` and reopen.  Caller holds
+        the lock; any failure propagates to emit()'s drop counter."""
+        import os
+        self._f.close()
+        os.replace(self.path, self.path + ".1")
+        self._f = open(self.path, "a")
+        self._size = 0
+        self.rotations += 1
 
     def emit(self, kind: str, **fields) -> bool:
         """Append one event.  Returns False (drop counted) on any
@@ -57,8 +81,13 @@ class EventLog:
             with self._lock:
                 if self._f is None:
                     raise ValueError("event log closed")
+                if (self.max_bytes and self._size > 0
+                        and self._size + len(line) + 1
+                        > self.max_bytes):
+                    self._rotate_locked()
                 self._f.write(line + "\n")
                 self._f.flush()
+                self._size += len(line) + 1
                 self.written += 1
             return True
         except Exception:  # noqa: BLE001 — telemetry never kills work

@@ -67,7 +67,7 @@ from ..utils import faults
 from ..utils.checkpoint import CheckpointManager
 from .engine import InferenceEngine, ServeSpec
 from .router import (LocalEngineHandle, Router, RouterSpec,
-                     HttpEngineHandle)
+                     HttpEngineHandle, _handle_call)
 from .server import InferenceServer
 
 
@@ -224,9 +224,15 @@ class RolloutController:
         pre = self._engine_counts(handle)
         self._baseline_p95 = self.router.stats.latency_quantile(0.95)
         with obs.span("fleet.rollout", phase="canary", engine=name,
-                      target=target):
+                      target=target) as fsp:
             try:
-                got = handle.reload(step=target)
+                # reload hop carries the rollout span's trace context
+                # (_handle_call drops it for handles without the kwarg)
+                got = _handle_call(
+                    handle.reload, (),
+                    {"step": target,
+                     "trace": ((fsp.trace, fsp.span_id)
+                               if fsp.trace else None)})
             except Exception as e:  # noqa: BLE001 — engine died on us
                 got = {"outcome": "failed", "step": -1,
                        "error": str(e)}
@@ -361,13 +367,18 @@ class RolloutController:
     def _promote(self, served: int) -> None:
         name, target = self.canary, self.target_step
         failures = []
-        with obs.span("fleet.rollout", phase="promote", target=target):
+        with obs.span("fleet.rollout", phase="promote",
+                      target=target) as fsp:
             for other in self.router.names():
                 if other == name:
                     continue
                 try:
                     handle = self.router.handle_for(other)
-                    got = handle.reload(step=target)
+                    got = _handle_call(
+                        handle.reload, (),
+                        {"step": target,
+                         "trace": ((fsp.trace, fsp.span_id)
+                                   if fsp.trace else None)})
                 except KeyError:
                     continue           # retired mid-promote: skip
                 except Exception as e:  # noqa: BLE001 — router will
@@ -438,7 +449,9 @@ class RolloutController:
         if name is None or name not in self.router.names():
             return                 # retired: nothing left to restore
         try:
-            self.router.handle_for(name).reload(step=self.pinned_step)
+            _handle_call(self.router.handle_for(name).reload, (),
+                         {"step": self.pinned_step,
+                          "trace": obs.trace_context()})
         except Exception as e:  # noqa: BLE001 — dead canary
             self.log(f"fleet: could not restore canary {name} to "
                      f"pinned step {self.pinned_step} ({e}); it "
@@ -718,6 +731,14 @@ class FleetServer:
             def do_GET(self):
                 if self.path == "/stats":
                     self._reply(200, fleet.snapshot())
+                elif self.path == "/trace":
+                    # this process's span ring, Perfetto-shaped —
+                    # obs.collect merges it with the workers' rings
+                    self._reply(200, obs.trace_dump())
+                elif self.path == "/debug/requests":
+                    # per-request lifecycle records: last-N + slowest-N
+                    # with stage attribution (router.RequestLog)
+                    self._reply(200, fleet.router.requests.snapshot())
                 elif self.path == "/metrics":
                     body = metrics.render_prometheus().encode()
                     self.send_response(200)
@@ -745,6 +766,14 @@ class FleetServer:
                 self.wfile.write(f"{len(data):X}\r\n".encode()
                                  + data + b"\r\n")
 
+            def _remote_trace(self):
+                """Client-supplied trace context (X-Trace-Id /
+                X-Parent-Span), or None — malformed headers degrade
+                to a fresh trace, never a 400 (qos.py)."""
+                return _qos.trace_from_headers(
+                    self.headers.get(_qos.TRACE_HEADER),
+                    self.headers.get(_qos.PARENT_SPAN_HEADER))
+
             def _stream(self, tokens, req):
                 """Chunked passthrough: re-serialize the engine's
                 token events as they arrive — the full body is never
@@ -754,14 +783,23 @@ class FleetServer:
                 mid-stream failure becomes a terminal {"error": ...}
                 line."""
                 mn = req.get("max_new")
-                stream = fleet.router.route_stream(
-                    tokens, timeout=req.get("timeout"),
-                    max_new=None if mn is None else int(mn),
-                    deadline=_qos.deadline_from_header(
-                        self.headers.get(_qos.DEADLINE_HEADER)),
-                    priority=_qos.check_priority(
-                        req.get("priority")
-                        or self.headers.get(_qos.PRIORITY_HEADER)))
+                link = self._remote_trace()
+                # the span covers ADMISSION only (route_stream admits
+                # eagerly and returns the generator) — the router's
+                # stream spans anchor to it via the thread-local; a
+                # span must never stay open across generator yields
+                with obs.span("fleet.request", mode="stream",
+                              trace=link[0] if link else None,
+                              parent=((link[1] or None)
+                                      if link else None)):
+                    stream = fleet.router.route_stream(
+                        tokens, timeout=req.get("timeout"),
+                        max_new=None if mn is None else int(mn),
+                        deadline=_qos.deadline_from_header(
+                            self.headers.get(_qos.DEADLINE_HEADER)),
+                        priority=_qos.check_priority(
+                            req.get("priority")
+                            or self.headers.get(_qos.PRIORITY_HEADER)))
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "application/x-ndjson")
@@ -790,13 +828,21 @@ class FleetServer:
                     if mode == "generate" and req.get("stream"):
                         self._stream(tokens, req)
                         return
-                    out = fleet.router.route(
-                        mode, tokens, timeout=req.get("timeout"),
-                        deadline=_qos.deadline_from_header(
-                            self.headers.get(_qos.DEADLINE_HEADER)),
-                        priority=_qos.check_priority(
-                            req.get("priority")
-                            or self.headers.get(_qos.PRIORITY_HEADER)))
+                    link = self._remote_trace()
+                    with obs.span("fleet.request", mode=mode,
+                                  trace=link[0] if link else None,
+                                  parent=((link[1] or None)
+                                          if link else None)):
+                        out = fleet.router.route(
+                            mode, tokens,
+                            timeout=req.get("timeout"),
+                            deadline=_qos.deadline_from_header(
+                                self.headers.get(
+                                    _qos.DEADLINE_HEADER)),
+                            priority=_qos.check_priority(
+                                req.get("priority")
+                                or self.headers.get(
+                                    _qos.PRIORITY_HEADER)))
                     self._reply(200, out)
                 except _OL as e:
                     self._reply(503, {"error": str(e),

@@ -46,6 +46,14 @@ PRIORITIES = ("interactive", "batch", "best_effort")
 DEADLINE_HEADER = "X-Deadline-Ms"
 PRIORITY_HEADER = "X-Priority"
 
+#: W3C-traceparent-style trace context pair: the trace id is minted
+#: once at the request's root span and carried VERBATIM on every hop
+#: (frontend → router → worker, hedge legs, failover resumes,
+#: /admin/reload); the parent span id lets the receiver anchor its
+#: own spans under the caller's, so a merged trace reads as one tree
+TRACE_HEADER = "X-Trace-Id"
+PARENT_SPAN_HEADER = "X-Parent-Span"
+
 #: Retry-After escalation factor per class: lower classes are told to
 #: stay away longer, so honest hints do the brownout's first pass
 _CLASS_FACTORS = (("interactive", 1.0), ("batch", 2.0),
@@ -109,6 +117,36 @@ def deadline_to_header(deadline: Optional[float]) -> Optional[str]:
     if rem is None:
         return None
     return str(max(int(rem * 1000), 0))
+
+
+def trace_to_headers(ctx) -> dict:
+    """Serialize an `obs.trace_context()` tuple — `(trace_id,
+    span_id)` — into the trace header pair ({} when there is no open
+    span / no session: tracing off must add zero bytes to the wire)."""
+    if not ctx:
+        return {}
+    trace_id, span_id = ctx
+    out = {}
+    if trace_id:
+        out[TRACE_HEADER] = str(trace_id)
+        if span_id:
+            out[PARENT_SPAN_HEADER] = str(span_id)
+    return out
+
+
+def trace_from_headers(trace_id: Optional[str],
+                       parent_span: Optional[str]):
+    """Parse the receive side back into `(trace_id, parent_span_id)`,
+    or None when no trace id was sent.  A malformed parent span id
+    degrades to 0 (root of a remote track) — a trace header must
+    never 400 a request that telemetry merely rides along on."""
+    if trace_id is None or not str(trace_id).strip():
+        return None
+    try:
+        psid = int(str(parent_span).strip()) if parent_span else 0
+    except (TypeError, ValueError):
+        psid = 0
+    return (str(trace_id).strip(), psid)
 
 
 def deadline_from_header(value: Optional[str]) -> Optional[float]:

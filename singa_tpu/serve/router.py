@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import dataclasses
 import inspect
+import itertools
 import json
 import queue
 import threading
@@ -353,21 +354,26 @@ class HttpEngineHandle:
 
     @staticmethod
     def _qos_headers(deadline: Optional[float],
-                     priority: Optional[str]) -> Dict[str, str]:
+                     priority: Optional[str],
+                     trace=None) -> Dict[str, str]:
         """End-to-end propagation over the wire: remaining-ms deadline
-        header (re-anchored by the receiver) + priority class."""
+        header (re-anchored by the receiver), priority class, and the
+        `X-Trace-Id`/`X-Parent-Span` pair — the worker's spans anchor
+        under the router's attempt span in the merged trace."""
         hdrs: Dict[str, str] = {}
         dl = qos.deadline_to_header(deadline)
         if dl is not None:
             hdrs[qos.DEADLINE_HEADER] = dl
         if priority is not None:
             hdrs[qos.PRIORITY_HEADER] = str(priority)
+        hdrs.update(qos.trace_to_headers(trace))
         return hdrs
 
     def request(self, mode: str, tokens,
                 timeout: Optional[float] = None,
                 deadline: Optional[float] = None,
-                priority: Optional[str] = None) -> Dict[str, Any]:
+                priority: Optional[str] = None,
+                trace=None) -> Dict[str, Any]:
         toks = (tokens.tolist() if isinstance(tokens, np.ndarray)
                 else list(tokens))
         payload = {"tokens": [int(t) for t in toks]}
@@ -376,13 +382,14 @@ class HttpEngineHandle:
         budget = qos.transport_budget(deadline, timeout,
                                       self.connect_timeout_s)
         return self._call("POST", f"/{mode}", payload, timeout=budget,
-                          headers=self._qos_headers(deadline, priority))
+                          headers=self._qos_headers(deadline, priority,
+                                                    trace))
 
     def request_stream(self, tokens, timeout: Optional[float] = None,
                        max_new: Optional[int] = None,
                        deadline: Optional[float] = None,
                        priority: Optional[str] = None,
-                       resume_from: int = 0):
+                       resume_from: int = 0, trace=None):
         """Streaming generate over HTTP: POST {"stream": true} and
         decode the chunked ndjson line-by-line WITHOUT buffering the
         body.  The response status is the commit point: admission
@@ -404,7 +411,7 @@ class HttpEngineHandle:
         budget = qos.transport_budget(deadline, timeout,
                                       self.connect_timeout_s)
         hdrs = {"Content-Type": "application/json"}
-        hdrs.update(self._qos_headers(deadline, priority))
+        hdrs.update(self._qos_headers(deadline, priority, trace))
         req = urllib.request.Request(
             f"{self.base_url}/generate",
             data=json.dumps(payload).encode(), method="POST",
@@ -451,9 +458,11 @@ class HttpEngineHandle:
                     f"engine {self.name} stream broken: {e}") from e
         return gen()
 
-    def reload(self, step: Optional[int] = None) -> Dict[str, Any]:
+    def reload(self, step: Optional[int] = None,
+               trace=None) -> Dict[str, Any]:
         return self._call("POST", "/admin/reload", {"step": step},
-                          timeout=60.0)
+                          timeout=60.0,
+                          headers=qos.trace_to_headers(trace))
 
 
 # -- router -----------------------------------------------------------------
@@ -490,6 +499,11 @@ class RouterStats:
               "expired_on_arrival", "budget_denied", "brownout_sheds",
               "shed_interactive", "shed_batch", "shed_best_effort")
 
+    #: per-request lifecycle stages the router can time (the stage
+    #: taxonomy in docs/OBSERVABILITY.md); each gets its own
+    #: `singa_request_stage_seconds_<stage>` histogram
+    STAGES = ("admit", "dispatch", "first_token", "decode")
+
     def __init__(self, window_s: float = 30.0):
         self.window_s = float(window_s)
         self._lock = threading.Lock()
@@ -502,6 +516,12 @@ class RouterStats:
                                                       #  brownout)
         self._done_t: deque = deque(maxlen=16384)     # (stamp, latency,
                                                       #  priority)
+        # owned histogram handles, attached by register_into (None
+        # without a registry — observe_latency/observe_stage stay
+        # cheap no-ops on the histogram half)
+        self._hist_latency = None
+        self._stage_registry = None
+        self._stage_hists: Dict[str, Any] = {}
 
     def count(self, fieldname: str, n: int = 1) -> None:
         now = time.monotonic()
@@ -536,6 +556,28 @@ class RouterStats:
             if len(self._latencies) > 4096:
                 del self._latencies[:2048]
             self._done_t.append((time.monotonic(), seconds, priority))
+        h = self._hist_latency
+        if h is not None:
+            h.observe(float(seconds))
+
+    def observe_stage(self, stage: str, seconds: float) -> None:
+        """One stage timing of a finished request.  Stage histograms
+        are created lazily in the registry attached by register_into
+        (no registry: no-op) — the stage partition shares the e2e
+        clock and its boundary stamps, so per-request stages sum to
+        the request's latency by construction."""
+        reg = self._stage_registry
+        if reg is None:
+            return
+        h = self._stage_hists.get(stage)
+        if h is None:
+            # idempotent: registry.histogram returns the same object
+            # for the same name, so a lost race costs nothing
+            h = reg.histogram(
+                f"singa_request_stage_seconds_{stage}",
+                f"per-request wall time in stage {stage!r}")
+            self._stage_hists[stage] = h
+        h.observe(float(seconds))
 
     def windowed(self, window_s: Optional[float] = None) -> Dict[str, Any]:
         """Rates over the trailing window (capped at uptime so a
@@ -615,6 +657,14 @@ class RouterStats:
                       prefix: str = "singa_fleet") -> None:
         from ..obs.metrics import Sample
 
+        # owned histograms beside the scalar collectors: the quantile
+        # gauges below are point estimates a scraper cannot aggregate
+        # across routers; cumulative buckets + _sum/_count can be
+        self._hist_latency = registry.histogram(
+            f"{prefix}_request_latency_seconds",
+            "end-to-end fleet request latency (seconds)")
+        self._stage_registry = registry
+
         def collect():
             snap = self.snapshot()
             out = [Sample(f"{prefix}_{k}_total", "counter",
@@ -631,6 +681,37 @@ class RouterStats:
             return out
 
         registry.register_collector(collect)
+
+
+class RequestLog:
+    """Per-request lifecycle records backing `GET /debug/requests`: a
+    bounded last-N ring plus the slowest-N ever seen, each row
+    carrying the corr/trace ids, the serving engine, per-stage
+    timings, and the leg story (hedged / resumes) — the post-mortem
+    index into the merged fleet trace (docs/OBSERVABILITY.md)."""
+
+    def __init__(self, keep: int = 64, slowest: int = 16):
+        self._lock = threading.Lock()
+        self._recent: deque = deque(maxlen=max(int(keep), 1))
+        self._slowest: List[Dict[str, Any]] = []
+        self._slowest_n = max(int(slowest), 1)
+        self.recorded = 0
+
+    def record(self, **rec) -> None:
+        rec.setdefault("ts", round(time.time(), 6))
+        with self._lock:
+            self.recorded += 1
+            self._recent.append(rec)
+            self._slowest.append(rec)
+            self._slowest.sort(
+                key=lambda r: -(r.get("latency_ms") or 0.0))
+            del self._slowest[self._slowest_n:]
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"recorded": self.recorded,
+                    "recent": list(self._recent),
+                    "slowest": list(self._slowest)}
 
 
 class Router:
@@ -665,6 +746,11 @@ class Router:
         # durable stream sessions: the journal mid-stream failover
         # resumes from (serve/session.py)
         self.sessions = SessionManager()
+        # per-request lifecycle records (GET /debug/requests)
+        self.requests = RequestLog()
+        # router-minted correlation ids for requests arriving without
+        # one (an in-process caller outside any span)
+        self._corr_ids = itertools.count(1)
         # cached control signals (recomputed at most every 0.5s: the
         # deques behind windowed() are too big for the hot path)
         self._hedge_cache: float = float(self.spec.hedge_max_s)
@@ -913,10 +999,10 @@ class Router:
 
     def _call_handle(self, name: str, mode: str, tokens,
                      timeout, deadline, priority,
-                     cancel_event) -> Dict[str, Any]:
+                     cancel_event, trace=None) -> Dict[str, Any]:
         """One engine call, forwarding only the QoS keywords the
         handle's `request` signature accepts (duck-typed handles
-        predate deadlines/priorities)."""
+        predate deadlines/priorities/trace context)."""
         with self._lock:
             m = self._members.get(name)
         if m is None:
@@ -925,7 +1011,8 @@ class Router:
         return _handle_call(
             m.handle.request, (mode, tokens),
             {"timeout": timeout, "deadline": deadline,
-             "priority": priority, "cancel_event": cancel_event})
+             "priority": priority, "cancel_event": cancel_event,
+             "trace": trace})
 
     def _try_hedge(self, exclude: set, cancels: Dict[str, Any],
                    launch, deadline) -> Optional[str]:
@@ -958,26 +1045,40 @@ class Router:
         return name
 
     def _hedged_request(self, name: str, mode: str, tokens,
-                        timeout, deadline, priority) -> tuple:
+                        timeout, deadline, priority,
+                        corr: Optional[str] = None, link=None,
+                        info: Optional[dict] = None) -> tuple:
         """Dispatch to `name`, hedging onto a sibling once the
         p95-derived delay elapses without a result; first result wins
         and the loser is cancelled.  Owns releasing every in-flight
         slot it holds (the caller's `_pick` took `name`'s).  Returns
         (winner, out) or raises the decisive exception — the
-        primary's, unless only the hedge answered."""
+        primary's, unless only the hedge answered.  `corr`/`link`
+        tag every leg with the ORIGINATING request's ids (hedge run()
+        threads have no thread-local parent — without the explicit
+        anchor each leg minted a fresh chain and the hedge was
+        invisible in any trace); `info` (when given) reports
+        `hedged=True` back to the caller."""
         resq: "queue.Queue" = queue.Queue()
         cancels: Dict[str, threading.Event] = {name: threading.Event()}
 
         def run(engine_name: str, site: Optional[str]) -> None:
             self.stats.count("attempts")
             try:
-                if site is not None:
-                    faults.maybe_fault(site)
-                out = self._call_handle(
-                    name=engine_name, mode=mode, tokens=tokens,
-                    timeout=timeout, deadline=deadline,
-                    priority=priority,
-                    cancel_event=cancels[engine_name])
+                with obs.span("router.attempt", corr=corr,
+                              trace=link[0] if link else None,
+                              parent=link[1] if link else None,
+                              engine=engine_name,
+                              hedge=engine_name != name) as asp:
+                    if site is not None:
+                        faults.maybe_fault(site)
+                    out = self._call_handle(
+                        name=engine_name, mode=mode, tokens=tokens,
+                        timeout=timeout, deadline=deadline,
+                        priority=priority,
+                        cancel_event=cancels[engine_name],
+                        trace=((asp.trace, asp.span_id)
+                               if asp.trace else None))
                 resq.put((engine_name, "ok", out))
             except (Overloaded, DeadlineExpired, TimeoutError,
                     ValueError, Cancelled) as e:
@@ -1021,6 +1122,8 @@ class Router:
                     set(cancels), cancels, launch, deadline)
                 if hedge_name is not None:
                     pending.add(hedge_name)
+                    if info is not None:
+                        info["hedged"] = True
                 continue
             pending.discard(ename)
             if kind == "ok":
@@ -1077,8 +1180,16 @@ class Router:
         saturated = 0
         budget_stopped = False
         last_exc: Optional[BaseException] = None
-        with obs.span("router.dispatch", mode=mode,
+        # the request's root ids on the router side: inherit the
+        # caller's corr (the fleet frontend's span) when dispatched
+        # under one, else mint fleet-N — every downstream leg
+        # (attempt, hedge, worker, resume) is tagged with them
+        corr = obs.current_corr() or f"fleet-{next(self._corr_ids)}"
+        hedged: Dict[str, Any] = {}
+        with obs.span("router.dispatch", corr=corr, mode=mode,
                       priority=priority) as sp:
+            link = (sp.trace, sp.span_id) if sp.trace else None
+            t1 = time.monotonic()    # admission done; dispatch begins
             for attempt in range(budget):
                 rem = qos.remaining_s(deadline)
                 if rem is not None and rem <= 0:
@@ -1100,7 +1211,7 @@ class Router:
                 try:
                     winner, out = self._hedged_request(
                         name, mode, tokens, timeout, deadline,
-                        priority)
+                        priority, corr=corr, link=link, info=hedged)
                 except Overloaded as e:
                     # load, not failure: no strike, try a sibling
                     saturated += 1
@@ -1127,10 +1238,30 @@ class Router:
                         m.dispatched += 1
                 self._shed_backoffs.reset(priority)
                 self.stats.count("completed")
-                self.stats.observe_latency(time.monotonic() - t0,
-                                           priority)
+                t2 = time.monotonic()
+                lat = t2 - t0
+                self.stats.observe_latency(lat, priority)
+                # stage partition shares the e2e clock and its
+                # boundary stamps: admit + dispatch == latency exactly
+                self.stats.observe_stage("admit", t1 - t0)
+                self.stats.observe_stage("dispatch", t2 - t1)
                 out["engine"] = winner
                 sp.set(engine=winner, attempts=attempt + 1)
+                self.requests.record(
+                    corr=corr, trace=sp.trace or None, mode=mode,
+                    engine=winner, priority=priority, outcome="ok",
+                    latency_ms=round(lat * 1e3, 3),
+                    hedged=bool(hedged), attempts=attempt + 1,
+                    stages_ms={
+                        "admit": round((t1 - t0) * 1e3, 3),
+                        "dispatch": round((t2 - t1) * 1e3, 3)})
+                if sp.trace:
+                    o = obs.active()
+                    p95 = (self.stats.latency_quantile(0.95)
+                           if o is not None
+                           and o.spec.sample == "tail" else None)
+                    obs.sample_trace(sp.trace, lat, p95_s=p95,
+                                     hedged=bool(hedged))
                 return out
             if budget_stopped and last_exc is not None:
                 # the retry budget ran dry: degrade to single-shot —
@@ -1153,7 +1284,7 @@ class Router:
 
     def _call_stream(self, name: str, tokens, timeout, max_new,
                      deadline, priority, cancel_event,
-                     resume_from: int = 0):
+                     resume_from: int = 0, trace=None):
         with self._lock:
             m = self._members.get(name)
         if m is None:
@@ -1164,10 +1295,12 @@ class Router:
             {"timeout": timeout, "max_new": max_new,
              "deadline": deadline, "priority": priority,
              "cancel_event": cancel_event,
-             "resume_from": resume_from})
+             "resume_from": resume_from, "trace": trace})
 
     def _hedged_stream(self, name: str, tokens, timeout, max_new,
-                       deadline, priority) -> tuple:
+                       deadline, priority,
+                       corr: Optional[str] = None, link=None,
+                       info: Optional[dict] = None) -> tuple:
         """Streaming twin of `_hedged_request`: FIRST BYTE wins — each
         attempt admits its stream and pulls one event; whichever
         event lands first commits that engine, the loser's
@@ -1185,12 +1318,24 @@ class Router:
             self.stats.count("attempts")
             ev = cancels[engine_name]
             try:
-                if site is not None:
-                    faults.maybe_fault(site)
-                gen = self._call_stream(engine_name, tokens, timeout,
-                                        max_new, deadline, priority,
-                                        ev)
-                first = next(gen)      # the first-byte commit
+                # the attempt span covers admission through the
+                # first-byte commit, anchored under the stream's root
+                # (run() threads have no thread-local parent); the
+                # worker anchors under THIS span via the trace kwarg
+                with obs.span("router.attempt", corr=corr,
+                              trace=link[0] if link else None,
+                              parent=link[1] if link else None,
+                              engine=engine_name,
+                              hedge=engine_name != name,
+                              stream=True) as asp:
+                    if site is not None:
+                        faults.maybe_fault(site)
+                    gen = self._call_stream(
+                        engine_name, tokens, timeout, max_new,
+                        deadline, priority, ev,
+                        trace=((asp.trace, asp.span_id)
+                               if asp.trace else None))
+                    first = next(gen)  # the first-byte commit
             except (Overloaded, DeadlineExpired, TimeoutError,
                     ValueError, Cancelled, StopIteration) as e:
                 self._release(engine_name)
@@ -1244,6 +1389,8 @@ class Router:
                     set(cancels), cancels, launch, deadline)
                 if hedge_name is not None:
                     pending.add(hedge_name)
+                    if info is not None:
+                        info["hedged"] = True
                 continue
             pending.discard(ename)
             if kind == "ok":
@@ -1294,6 +1441,9 @@ class Router:
         deadline = qos.resolve_deadline(timeout, deadline,
                                         self.spec.request_timeout_s)
         t0 = time.monotonic()
+        # stage-boundary stamps on the tracer's clock (perf_counter):
+        # post-hoc stream-stage spans are recorded from these
+        p0 = time.perf_counter()
         rem = qos.remaining_s(deadline)
         if rem is not None and rem <= 0:
             self.stats.count("expired_on_arrival")
@@ -1311,52 +1461,70 @@ class Router:
         saturated = 0
         budget_stopped = False
         last_exc: Optional[BaseException] = None
-        for attempt in range(budget):
-            rem = qos.remaining_s(deadline)
-            if rem is not None and rem <= 0:
-                self.stats.count("deadline_terminal")
-                raise DeadlineExpired(
-                    f"deadline exhausted after {attempt} attempt(s)")
-            if attempt > 0 and not self.retry_budget.spend():
-                self.stats.count("budget_denied")
-                budget_stopped = True
-                break
-            name = self._pick(tried)
-            if name is None:
-                if attempt > 0:
-                    self.retry_budget.refund()
-                break
-            tried.add(name)
-            try:
-                winner, first, gen, cancel = self._hedged_stream(
-                    name, tokens, timeout, max_new, deadline,
-                    priority)
-            except Overloaded as e:
-                saturated += 1
-                last_exc = e
-                self.stats.count("retried")
-                continue
-            except (DeadlineExpired, TimeoutError):
-                self.stats.count("deadline_terminal")
-                raise
-            except ValueError:
-                self.stats.count("failed")
-                raise
-            except Exception as e:  # noqa: BLE001 — engine failure
-                last_exc = e
-                self.stats.count("retried")
-                continue
-            # committed to this engine: open the durable session —
-            # the journal + leg pump that let the stream survive the
-            # engine (docs/SERVING.md, "Mid-stream failover")
-            session = self.sessions.open(
-                prompt=tokens, max_new=max_new, deadline=deadline,
-                priority=priority, engine=winner,
-                step=self.engine_step(winner))
-            leg = _StreamLeg(self, session, winner, gen, cancel,
-                             first=first)
-            return self._session_stream(session, leg, t0, priority,
-                                        timeout)
+        corr = obs.current_corr() or f"fleet-{next(self._corr_ids)}"
+        hedged: Dict[str, Any] = {}
+        # the stream's root span covers ONLY admission through the
+        # first-byte commit and closes before the generator is handed
+        # out — a span must never stay open across generator yields
+        # (the consumer's pull cadence is not ours).  Post-admission
+        # stages are recorded post-hoc against `link` at terminal.
+        with obs.span("router.stream", corr=corr, mode="generate",
+                      priority=priority) as sp:
+            link = (sp.trace, sp.span_id) if sp.trace else None
+            pa = time.perf_counter()  # admission done; dispatch begins
+            for attempt in range(budget):
+                rem = qos.remaining_s(deadline)
+                if rem is not None and rem <= 0:
+                    self.stats.count("deadline_terminal")
+                    raise DeadlineExpired(
+                        f"deadline exhausted after {attempt} "
+                        f"attempt(s)")
+                if attempt > 0 and not self.retry_budget.spend():
+                    self.stats.count("budget_denied")
+                    budget_stopped = True
+                    break
+                name = self._pick(tried)
+                if name is None:
+                    if attempt > 0:
+                        self.retry_budget.refund()
+                    break
+                tried.add(name)
+                try:
+                    winner, first, gen, cancel = self._hedged_stream(
+                        name, tokens, timeout, max_new, deadline,
+                        priority, corr=corr, link=link, info=hedged)
+                except Overloaded as e:
+                    saturated += 1
+                    last_exc = e
+                    self.stats.count("retried")
+                    continue
+                except (DeadlineExpired, TimeoutError):
+                    self.stats.count("deadline_terminal")
+                    raise
+                except ValueError:
+                    self.stats.count("failed")
+                    raise
+                except Exception as e:  # noqa: BLE001 — engine failure
+                    last_exc = e
+                    self.stats.count("retried")
+                    continue
+                # committed to this engine: open the durable session —
+                # the journal + leg pump that let the stream survive
+                # the engine (docs/SERVING.md, "Mid-stream failover").
+                # It carries the originating corr + trace link so a
+                # failover leg admitted later lands in the SAME trace.
+                session = self.sessions.open(
+                    prompt=tokens, max_new=max_new, deadline=deadline,
+                    priority=priority, engine=winner,
+                    step=self.engine_step(winner), corr=corr,
+                    trace=link)
+                leg = _StreamLeg(self, session, winner, gen, cancel,
+                                 first=first)
+                sp.set(engine=winner, attempts=attempt + 1)
+                return self._session_stream(
+                    session, leg, t0, priority, timeout,
+                    p0=p0, pa=pa, p1=time.perf_counter(),
+                    link=link, hedged=bool(hedged))
         if budget_stopped and last_exc is not None:
             if isinstance(last_exc, Overloaded):
                 self.stats.observe_shed(priority)
@@ -1373,18 +1541,72 @@ class Router:
         self._shed(why, priority=priority)
 
     def _session_stream(self, session, leg, t0: float, priority: str,
-                        timeout: Optional[float]):
+                        timeout: Optional[float], p0=None, pa=None,
+                        p1=None, link=None, hedged: bool = False):
         """Consumer loop of a durable stream: journals every token by
         absolute sequence number, dedupes the splice (each index
         reaches the client AT MOST once), arms the per-stream idle
         watchdog, and on any leg death — transport break, silent
         stall, sequence gap, drain-timeout kick — swaps in a resume
         leg from `_failover_leg`.  The client iterator only learns a
-        leg died when resume itself is impossible."""
+        leg died when resume itself is impossible.  `p0`/`pa`/`p1`
+        are the admit / dispatch-start / first-byte stage stamps from
+        route_stream (tracer clock); the terminal records the stream
+        stages post-hoc against `link`."""
         sstats = self.sessions.stats
         idle = float(self.spec.stream_idle_s)
         state = "failed"
         finished = False
+        staged = False
+
+        def _finish(outcome: str) -> None:
+            """Terminal bookkeeping, exactly once: post-hoc stream
+            stage spans (admit/first_token/decode partition the e2e
+            latency exactly — one clock, shared boundary stamps), the
+            stage histograms, the /debug/requests record, and the
+            tail-sampling verdict for this request's trace."""
+            nonlocal staged
+            if staged:
+                return
+            staged = True
+            p3 = time.perf_counter()
+            lat = (p3 - p0) if p0 is not None else 0.0
+            stages: Dict[str, float] = {}
+            if p0 is not None and pa is not None and p1 is not None:
+                stages = {"admit": pa - p0,
+                          "first_token": p1 - pa,
+                          "decode": p3 - p1}
+                for st, secs in stages.items():
+                    self.stats.observe_stage(st, secs)
+            o = obs.active()
+            if o is not None and link and p1 is not None:
+                tr, psid = link
+                o.tracer.add_span(
+                    "stream.first_token", pa, p1 - pa,
+                    corr=session.corr, trace=tr, parent=psid,
+                    engine=session.engine)
+                o.tracer.add_span(
+                    "stream.decode", p1, p3 - p1, corr=session.corr,
+                    trace=tr, parent=psid, engine=session.engine,
+                    tokens=len(session.emitted),
+                    resumes=session.resumes)
+            self.requests.record(
+                corr=session.corr, trace=link[0] if link else None,
+                mode="stream", engine=session.engine,
+                priority=priority, outcome=outcome,
+                latency_ms=round(lat * 1e3, 3), hedged=hedged,
+                resumes=session.resumes,
+                tokens=len(session.emitted),
+                stages_ms={k: round(v * 1e3, 3)
+                           for k, v in stages.items()})
+            if link:
+                p95 = (self.stats.latency_quantile(0.95)
+                       if o is not None
+                       and o.spec.sample == "tail" else None)
+                obs.sample_trace(
+                    link[0], lat, p95_s=p95,
+                    failed=outcome not in ("done", "spliced"),
+                    hedged=hedged, resumed=session.resumes > 0)
 
         def terminal(ev):
             """Splice the terminal event: the FULL token list from
@@ -1448,6 +1670,7 @@ class Router:
                 if ev.get("done"):
                     state = "spliced" if session.resumes else "done"
                     finished = True
+                    _finish(state)
                     yield terminal(ev)
                     return
                 i = int(ev.get("i", session.next_i))
@@ -1470,17 +1693,20 @@ class Router:
             # every token (the leg died between its last token and
             # its terminal event) — synthesize the done honestly
             state, finished = "spliced", True
+            _finish(state)
             yield terminal({"done": True, "finish": "length",
                             "step": session.step})
         except _FailoverStale as e:
             # no same-fingerprint engine remains: an honest terminal
             # with the journaled prefix, never a cross-checkpoint lie
             state, finished = "failover_stale", True
+            _finish(state)
             yield {"done": True, "finish": "failover_stale",
                    "engine": session.engine, "step": session.step,
                    "tokens": list(session.emitted),
                    "resumes": session.resumes, "error": str(e)}
         finally:
+            _finish(state if finished else "failed")
             if leg is not None:
                 (leg.release if finished else leg.abandon)()
             self.sessions.close(session, state)
@@ -1575,11 +1801,28 @@ class Router:
             at = session.next_i
             try:
                 self.stats.count("attempts")
-                gen = self._call_stream(
-                    name, session.resume_tokens(), timeout,
-                    session.max_new, session.deadline,
-                    session.priority, cancel, resume_from=at)
-                first = next(gen)
+                # the resume leg is anchored on the session's stored
+                # trace link and tagged with the ORIGINATING corr —
+                # this code runs on whatever thread the consumer loop
+                # happens to own, seconds after the root span closed,
+                # so only the explicit anchor keeps primary and
+                # resumed legs in ONE trace (the old leg minted a
+                # fresh chain and the splice was invisible)
+                with obs.span(
+                        "router.resume", corr=session.corr,
+                        trace=(session.trace[0]
+                               if session.trace else None),
+                        parent=(session.trace[1]
+                                if session.trace else None),
+                        engine=name, from_engine=old_engine,
+                        at=at) as rsp:
+                    gen = self._call_stream(
+                        name, session.resume_tokens(), timeout,
+                        session.max_new, session.deadline,
+                        session.priority, cancel, resume_from=at,
+                        trace=((rsp.trace, rsp.span_id)
+                               if rsp.trace else None))
+                    first = next(gen)
             except Overloaded:
                 self._release(name)
                 continue              # saturated sibling: try another
@@ -1638,6 +1881,15 @@ class Router:
               brownout: bool = False) -> None:
         self.stats.observe_shed(priority, brownout=brownout)
         retry = self._shed_backoffs.shed_delay(priority)
+        # a shed is a terminal outcome: record it (corr/trace from
+        # the enclosing dispatch span, when one is open) and keep its
+        # trace — sheds are always interesting to the tail sampler
+        tr = obs.trace_context()
+        self.requests.record(
+            corr=obs.current_corr(), trace=tr[0] if tr else None,
+            priority=priority, outcome="shed", why=why)
+        if tr:
+            obs.sample_trace(tr[0], 0.0, shed=True)
         obs.emit_event("serve.shed", why=f"router: {why}",
                        priority=priority,
                        retry_after=round(retry, 4))

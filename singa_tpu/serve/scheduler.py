@@ -145,6 +145,10 @@ class _CBRequest:
     cancel_event: Optional[threading.Event] = None
     t_admit: float = 0.0
     produced: List[int] = field(default_factory=list)
+    # trace context captured at submit — the prefill runs on the
+    # scheduler loop thread, so its span needs an explicit anchor to
+    # land in the submitting request's trace
+    link: Any = None
 
 
 class ContinuousScheduler:
@@ -312,13 +316,19 @@ class ContinuousScheduler:
             raise DeadlineExpired(
                 f"dead on arrival: deadline passed "
                 f"{now - deadline:.3f}s before admission")
-        corr = f"cbreq-{next(self._req_ids)}"
+        # inherit the caller's correlation chain when one is open on
+        # this thread (the HTTP handler's serve.request span) instead
+        # of unconditionally minting a fresh cbreq-N — the old mint
+        # silently severed router→scheduler correlation on every hop
+        corr = obs.current_corr() or f"cbreq-{next(self._req_ids)}"
+        link = obs.trace_context()
         req = _CBRequest(tokens=arr, plen=int(arr.size), max_new=mn,
                          nblocks=nblocks,
                          ticket=StreamTicket(corr,
                                              first_index=resume_from),
                          t_submit=now, deadline=deadline, corr=corr,
-                         priority=priority, cancel_event=cancel_event)
+                         priority=priority, cancel_event=cancel_event,
+                         link=link)
         with obs.span("scheduler.admit", corr=corr,
                       plen=int(arr.size), max_new=mn,
                       priority=priority):
@@ -462,6 +472,8 @@ class ContinuousScheduler:
             toks[0, :req.plen] = req.tokens
             try:
                 with obs.span("scheduler.prefill", corr=req.corr,
+                              trace=req.link[0] if req.link else None,
+                              parent=req.link[1] if req.link else None,
                               slot=slot, plen=req.plen):
                     tok0, self.kv.pools = self.engine.run_cb_prefill(
                         params, self.kv.pools, toks, req.plen,

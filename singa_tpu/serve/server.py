@@ -35,6 +35,10 @@ Endpoints:
                     after `degraded_after` consecutive failed batches
                     or a refused/failed reload leaving stale params —
                     the signal the fleet router dispatches on
+    GET  /trace     this process's span ring as a Perfetto dict
+                    (obs.trace_dump(); empty when tracing is off) —
+                    the buffer obs/collect.py pulls to merge fleet
+                    traces into one timeline
     POST /admin/reload  {"step": n?} -> engine.reload_to(step): the
                     fleet rollout controller's command channel for
                     remote (subprocess) engine members; returns
@@ -57,6 +61,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from .. import obs
 from ..obs.metrics import MetricsRegistry
 from . import qos
 from .batcher import DeadlineExpired, MicroBatcher, Overloaded
@@ -293,18 +298,41 @@ def _make_handler(server: InferenceServer):
             elif self.path == "/healthz":
                 h = server.engine.health()
                 self._reply(200 if h["ok"] else 503, h)
+            elif self.path == "/trace":
+                # this worker's span ring (Perfetto dict, carrying
+                # wall_origin_s + process tags) — what obs/collect.py
+                # pulls to merge the fleet's buffers into one timeline
+                self._reply(200, obs.trace_dump())
             else:
                 self._reply(404, {"error": f"no route {self.path}"})
 
+        def _remote_trace(self):
+            """The caller's trace context from the header pair, or
+            None — the anchor that makes this process's spans children
+            of the router's dispatch span after the merge."""
+            return qos.trace_from_headers(
+                self.headers.get(qos.TRACE_HEADER),
+                self.headers.get(qos.PARENT_SPAN_HEADER))
+
         def do_POST(self):
             mode = self.path.lstrip("/")
+            # trace context rides every POST: the span this handler
+            # opens is anchored under the caller's parent span id, so
+            # the merged fleet trace shows router dispatch -> worker
+            # admission as one tree (qos.trace_from_headers never
+            # rejects a request over a malformed telemetry header)
+            link = self._remote_trace()
+            tr = link[0] if link else None
+            psid = (link[1] or None) if link else None
             if self.path == "/admin/reload":
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(n) or b"{}")
                     step = req.get("step")
-                    outcome = server.engine.reload_to(
-                        None if step is None else int(step))
+                    with obs.span("serve.reload", trace=tr,
+                                  parent=psid, step=step):
+                        outcome = server.engine.reload_to(
+                            None if step is None else int(step))
                     self._reply(200, {
                         "outcome": outcome,
                         "step": server.engine.params_step})
@@ -326,25 +354,28 @@ def _make_handler(server: InferenceServer):
                 priority = qos.check_priority(
                     req.get("priority")
                     or self.headers.get(qos.PRIORITY_HEADER))
-                if mode == "generate":
-                    max_new = req.get("max_new")
-                    if max_new is not None:
-                        max_new = int(max_new)
-                    if req.get("stream") and \
-                            server.scheduler is not None:
-                        self._stream_generate(
-                            tokens, timeout, max_new, deadline,
-                            priority,
-                            resume_from=int(req.get("resume_from", 0)))
-                        return
-                    out = server.generate(tokens, timeout=timeout,
-                                          max_new=max_new,
-                                          deadline=deadline,
-                                          priority=priority)
-                else:
-                    out = server.predict(tokens, timeout=timeout,
-                                         deadline=deadline,
-                                         priority=priority)
+                with obs.span("serve.request", trace=tr, parent=psid,
+                              mode=mode, priority=priority):
+                    if mode == "generate":
+                        max_new = req.get("max_new")
+                        if max_new is not None:
+                            max_new = int(max_new)
+                        if req.get("stream") and \
+                                server.scheduler is not None:
+                            self._stream_generate(
+                                tokens, timeout, max_new, deadline,
+                                priority,
+                                resume_from=int(
+                                    req.get("resume_from", 0)))
+                            return
+                        out = server.generate(tokens, timeout=timeout,
+                                              max_new=max_new,
+                                              deadline=deadline,
+                                              priority=priority)
+                    else:
+                        out = server.predict(tokens, timeout=timeout,
+                                             deadline=deadline,
+                                             priority=priority)
                 self._reply(200, out)
             except Overloaded as e:
                 self._reply(503, {"error": str(e),

@@ -95,7 +95,8 @@ class StreamSession:
 
     def __init__(self, sid: str, prompt: np.ndarray,
                  max_new: Optional[int], deadline: Optional[float],
-                 priority: str, engine: str, step: int):
+                 priority: str, engine: str, step: int,
+                 corr: Optional[str] = None, trace=None):
         self.sid = sid
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new = (int(max_new) if max_new is not None else None)
@@ -103,6 +104,13 @@ class StreamSession:
         self.priority = priority
         self.engine = engine          # current leg's engine
         self.step = int(step)         # serving fingerprint (ckpt step)
+        # trace context of the originating request — `(trace_id,
+        # root_span_id)` — so a failover leg admitted seconds later on
+        # a different thread still lands in the SAME trace, tagged
+        # with the originating corr (the old legs minted fresh chains
+        # and the splice was invisible in any trace)
+        self.corr = corr
+        self.trace = trace
         self.emitted: List[int] = []  # the journal: token i at [i]
         self.next_i = 0               # dedupe cursor: next index owed
         self.resumes = 0
@@ -154,10 +162,11 @@ class SessionManager:
 
     def open(self, prompt, max_new: Optional[int],
              deadline: Optional[float], priority: str,
-             engine: str, step: int) -> StreamSession:
+             engine: str, step: int, corr: Optional[str] = None,
+             trace=None) -> StreamSession:
         sid = f"stream-{next(self._ids)}"
         s = StreamSession(sid, prompt, max_new, deadline, priority,
-                          engine, step)
+                          engine, step, corr=corr, trace=trace)
         with self._lock:
             self._sessions[sid] = s
         self.stats.count("opened")

@@ -114,6 +114,12 @@ class ServeStats:
         self.reload_failures = 0   # restore raised → kept old params
         self.reloads_refused = 0   # nothing newer / unhealthy walk-back
         self.torn_polls = 0        # poll raced a live writer → no change
+        # real Prometheus histograms (cumulative buckets + _sum/_count)
+        # created by register_into(); None until then so the hot path
+        # costs one attribute check when /metrics is not wired
+        self._hist_latency = None
+        self._hist_queue_wait = None
+        self._hist_service = None
 
     # -- mutation ----------------------------------------------------------
     def count(self, field: str, n: int = 1) -> None:
@@ -148,6 +154,8 @@ class ServeStats:
             now = time.monotonic()
             self._completions.append(now)
             self._timed_lats.append((now, seconds))
+        if self._hist_latency is not None:
+            self._hist_latency.observe(float(seconds))
 
     def observe_request(self, queue_wait_s: float, service_s: float,
                         ntokens: int) -> None:
@@ -162,6 +170,10 @@ class ServeStats:
             self.generated_tokens += int(ntokens)
             if ntokens > 0 and service_s > 0:
                 self._tok_rates.append(ntokens / service_s)
+        if self._hist_queue_wait is not None:
+            self._hist_queue_wait.observe(max(float(queue_wait_s), 0.0))
+        if self._hist_service is not None:
+            self._hist_service.observe(max(float(service_s), 0.0))
 
     def observe_cb_step(self, active_slots: int,
                         blocks_in_use: int) -> None:
@@ -338,6 +350,19 @@ class ServeStats:
             return out
 
         registry.register_collector(collect)
+        # real histograms (cumulative le buckets + _sum/_count) next
+        # to the reservoir quantiles: the reservoir gives honest
+        # recent p50/p95, the histogram aggregates across scrapes and
+        # fleet members the way Prometheus expects
+        self._hist_latency = registry.histogram(
+            f"{prefix}_request_latency_seconds",
+            "end-to-end request latency on this engine")
+        self._hist_queue_wait = registry.histogram(
+            f"{prefix}_queue_wait_seconds",
+            "time queued before dispatch/admission")
+        self._hist_service = registry.histogram(
+            f"{prefix}_service_seconds",
+            "time being served after dispatch")
 
     def snapshot(self) -> Dict[str, Any]:
         """JSON-ready view for /stats and BENCH_pr5.json."""

@@ -82,6 +82,11 @@ restart — so a one-shot fault never re-fires during recovery):
                    obs write path swallows the fault into a drop
                    counter, proving telemetry failure never takes
                    down training or serving)
+    obs.flush      the observability session teardown (trace export,
+                   final metrics dump, event-log close — a faulted
+                   flush is itself a flight-recorder trigger:
+                   `flightrec-obs_flush_fault-*.json` preserves the
+                   window the lost export would have covered)
     serve.resume   one mid-stream failover resume attempt
                    (Router._failover_leg — an error abandons the
                    resume and the stream degrades to the pre-failover
@@ -129,7 +134,7 @@ SITES = ("data.decode", "data.prefetch", "feed.stage", "ckpt.save",
          "step.grad", "serve.admit", "serve.batch", "serve.reload",
          "serve.hedge", "engine.stall", "fleet.dispatch",
          "fleet.rollout", "pipeline.publish", "scale.decide",
-         "obs.emit", "serve.resume")
+         "obs.emit", "serve.resume", "obs.flush")
 
 KINDS = ("error", "preempt", "corrupt", "torn", "nan", "spike",
          "stall")

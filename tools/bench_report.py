@@ -52,6 +52,12 @@ REQUIRED_GATES = {
         "failover_parity_mismatch", "resume_fault_terminal",
         "resume_fault_dup_tokens", "idle_watchdog_resumed",
     ),
+    "BENCH_pr14.json": (
+        "trace_ids_per_request", "trace_resume_in_trace",
+        "trace_hedge_legs", "trace_engines_spanned",
+        "trace_orphan_spans", "stage_attribution_err",
+        "flightrec_replayed", "trace_overhead",
+    ),
 }
 
 
