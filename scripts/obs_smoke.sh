@@ -309,5 +309,26 @@ print(f"BENCH_pr6.json ok: obs overhead {d['value']*100:.2f}% "
       f"(gate {d['gate']*100:.0f}%), "
       f"off={d['wall_obs_off_s']}s on={d['wall_obs_on_s']}s")
 EOF
+# Leg 6 (ISSUE 15): the performance observatory end to end — exactly
+# 2 cb compiles at warmup and 0 after under mixed load (the
+# recompile-anomaly counter stays 0), readiness timers and the HBM
+# watermark exported in /metrics, CostWatch harvesting adds 0
+# compiles, observatory overhead under the same 3% bar, and the
+# bench-trajectory report rendering every BENCH_pr*.json.
+python bench.py --perf-smoke --out BENCH_pr15.json > /dev/null
+python - <<'EOF'
+import json
+with open("BENCH_pr15.json") as f:
+    d = json.load(f)
+bad = {k: g for k, g in d["gates"].items() if not g["pass"]}
+assert not bad, f"perf smoke gates failed: {bad}"
+print(f"BENCH_pr15.json ok: {len(d['gates'])} gates pass "
+      f"(post-warmup compiles {d['value']}, "
+      f"restart-to-serving {d['restart_to_serving_s']}s, "
+      f"watermark {d['hbm_watermark_bytes']}B, "
+      f"obs overhead {d['obs_overhead']*100:.2f}%)")
+EOF
+python tools/bench_report.py --trajectory > /dev/null
+
 echo "OBS SMOKE PASS: traces + events + /metrics artifacts verified,"
-echo "  telemetry overhead under the 3% gate"
+echo "  telemetry overhead under the 3% gate, perf observatory gated"

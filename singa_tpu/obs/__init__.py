@@ -44,6 +44,7 @@ import threading
 from dataclasses import dataclass, fields
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from . import perf
 from .flightrec import FlightRecorder
 from .log import EventLog, Logger, MetricsDumper
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
@@ -56,7 +57,7 @@ __all__ = [
     "trace_dump", "emit_event", "sample_trace", "get_logger",
     "registry", "Tracer", "FlightRecorder", "MetricsRegistry",
     "Counter", "Gauge", "Histogram", "Sample", "EventLog", "Logger",
-    "parse_prometheus",
+    "parse_prometheus", "perf",
 ]
 
 
@@ -167,6 +168,11 @@ class Observability:
                              ring=self.spec.trace_ring,
                              process=self.spec.process or None)
         self.registry = MetricsRegistry()
+        # the performance observatory and the process collector ride
+        # on every session registry (perf.register_into survives
+        # perf.reset(): its collector re-reads the singleton)
+        perf.register_into(self.registry)
+        perf.register_process_into(self.registry)
         self.sampler = TailSampler(self.spec)
         self.events: Optional[EventLog] = (
             EventLog(self.spec.events,
@@ -175,7 +181,8 @@ class Observability:
             if self.spec.events else None)
         self.flightrec: Optional[FlightRecorder] = (
             FlightRecorder(self.spec.flightrec,
-                           ring=self.spec.flightrec_ring)
+                           ring=self.spec.flightrec_ring,
+                           extra_fn=perf.flightrec_context)
             if self.spec.flightrec else None)
         self._dumper: Optional[MetricsDumper] = (
             MetricsDumper(self.registry, self.events,

@@ -43,6 +43,10 @@ TRIGGER_KINDS = {
     "fleet.canary_abort": "rollback",
     "fleet.quarantine": "quarantine",
     "stream.resume": "failover",
+    # PR 8's "zero recompiles after warmup" as a monitored invariant:
+    # a compile landing in an already-warm scope is an anomaly worth a
+    # post-mortem window (what request geometry broke the buckets?)
+    "perf.recompile_anomaly": "recompile",
 }
 
 #: `serve.shed` events inside the window that constitute a storm
@@ -60,9 +64,13 @@ class FlightRecorder:
     a dump — the `obs.flush` fault path uses it directly."""
 
     def __init__(self, out_dir: str, ring: int = 512,
-                 cooldown_s: float = 5.0):
+                 cooldown_s: float = 5.0, extra_fn=None):
         self.out_dir = out_dir
         self.cooldown_s = max(float(cooldown_s), 0.0)
+        # optional () -> dict merged into each dump under "perf" —
+        # Observability wires the perf watch's watermark/readiness
+        # snapshot here so memory state rides along with the evidence
+        self.extra_fn = extra_fn
         self.dumps = 0
         self.dump_failures = 0
         self.sheds_seen = 0
@@ -128,6 +136,11 @@ class FlightRecorder:
                     "process": getattr(tracer, "process", None),
                     "context": context,
                     "events": events, "spans": spans}
+            if self.extra_fn is not None:
+                try:
+                    dump["perf"] = self.extra_fn()
+                except Exception:  # noqa: BLE001 — evidence is
+                    pass           # best-effort, never a new failure
             os.makedirs(self.out_dir, exist_ok=True)
             safe = "".join(c if c.isalnum() or c in "-_" else "_"
                            for c in why)

@@ -32,11 +32,17 @@ from typing import Any, Callable, Dict, Iterable, List, NamedTuple, Optional, Tu
 
 
 class Sample(NamedTuple):
-    """One scrape-time sample from a collector."""
+    """One scrape-time sample from a collector.
+
+    `labels` is an optional tuple of (key, value) pairs rendered as
+    `name{key="value",...}`.  Samples sharing a name (differing only
+    in labels) render one HELP/TYPE header followed by every series —
+    how `singa_compiles_total{program=...}` fans out per program."""
     name: str
     mtype: str          # "counter" | "gauge" | "histogram"(owned only)
     help: str
     value: float
+    labels: Tuple[Tuple[str, str], ...] = ()
 
 
 class Counter:
@@ -126,6 +132,19 @@ def sanitize(name: str) -> str:
     if s and s[0].isdigit():
         s = "_" + s
     return s or "_"
+
+
+def _label_str(labels: Tuple[Tuple[str, str], ...]) -> str:
+    """Render a Sample's label pairs as `{k="v",...}` (empty string
+    when unlabeled).  Values are escaped per the exposition format."""
+    if not labels:
+        return ""
+    parts = []
+    for k, v in labels:
+        s = str(v).replace("\\", "\\\\").replace('"', '\\"')
+        s = s.replace("\n", "\\n")
+        parts.append(f'{sanitize(str(k))}="{s}"')
+    return "{" + ",".join(parts) + "}"
 
 
 def _fmt(v: float) -> str:
@@ -218,12 +237,16 @@ class MetricsRegistry:
                         "gauge")
                 lines.append(f"# TYPE {name} {kind}")
                 lines.append(f"{name} {_fmt(m.value)}")
+        headed = set()
         for s in self._collect():
             name = sanitize(s.name)
-            if s.help:
-                lines.append(f"# HELP {name} {s.help}")
-            lines.append(f"# TYPE {name} {s.mtype}")
-            lines.append(f"{name} {_fmt(s.value)}")
+            if name not in headed:       # one HELP/TYPE per name even
+                headed.add(name)         # when labels fan out series
+                if s.help:
+                    lines.append(f"# HELP {name} {s.help}")
+                lines.append(f"# TYPE {name} {s.mtype}")
+            labels = _label_str(getattr(s, "labels", ()))
+            lines.append(f"{name}{labels} {_fmt(s.value)}")
         return "\n".join(lines) + "\n"
 
     def snapshot(self) -> Dict[str, float]:
@@ -241,7 +264,8 @@ class MetricsRegistry:
             else:
                 out[name] = m.value
         for s in self._collect():
-            out[sanitize(s.name)] = s.value
+            labels = _label_str(getattr(s, "labels", ()))
+            out[sanitize(s.name) + labels] = s.value
         return out
 
 

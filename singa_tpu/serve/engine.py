@@ -48,6 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
+from ..obs import perf
 from ..models.generate import (_sample, forward_cached, forward_paged,
                                init_cache, scatter_prefill)
 from ..utils import faults
@@ -302,6 +303,11 @@ class InferenceEngine:
         self._prev_step: Optional[int] = None
         self._compiled: Dict[Tuple[str, int, int], Any] = {}
         self._compile_lock = threading.Lock()
+        # CompileWatch scope: per-engine, so a fleet member (or a
+        # fresh autoscaled engine) warming up after its siblings never
+        # reads as a recompile anomaly — only a compile AFTER this
+        # engine's own warmup() trips the invariant
+        self._perf_scope = f"engine-{id(self):x}"
         self._key_counter = 0
         self._key_lock = threading.Lock()
         # injected straggler latency (engine.stall / set_stall): a
@@ -327,6 +333,8 @@ class InferenceEngine:
                 f"geometry than the serving model; refusing the swap")
         self._params = new            # atomic: one attribute store
         self.params_step = step
+        perf.set_memory_tree("serve_params", new,
+                             scope=self._perf_scope)
 
     def load(self) -> int:
         """Initial load: latest healthy checkpoint (walks back past
@@ -343,6 +351,9 @@ class InferenceEngine:
                 raise RuntimeError(
                     f"no restorable healthy checkpoint under "
                     f"{self.ckpt.dir} and no fallback params")
+        if self._params is not None:   # constructor-params path never
+            perf.set_memory_tree(      # went through _swap
+                "serve_params", self._params, scope=self._perf_scope)
         return self.params_step
 
     def poll_reload(self) -> str:
@@ -661,16 +672,24 @@ class InferenceEngine:
         key = (f"cb_{which}", spec.cb_slots, spec.cb_blocks_per_slot)
         got = self._compiled.get(key)
         if got is not None:
+            perf.lookup_hit(key[0])
             return got
         with self._compile_lock:
             got = self._compiled.get(key)
             if got is not None:
+                perf.lookup_hit(key[0])
                 return got
             if self._params is None:
                 raise RuntimeError("engine has no params; call load()")
+            geometry = (f"slots={spec.cb_slots},"
+                        f"blocks={spec.cb_pool_blocks},"
+                        f"block_len={spec.cb_block_len}")
             with obs.span("engine.compile", mode=f"cb_{which}",
                           slots=spec.cb_slots,
-                          blocks=spec.cb_pool_blocks):
+                          blocks=spec.cb_pool_blocks), \
+                 perf.compile_span(key[0], geometry=geometry,
+                                   scope=self._perf_scope,
+                                   family="generate"):
                 p_spec = jax.tree_util.tree_map(
                     lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
                     self._params)
@@ -699,6 +718,11 @@ class InferenceEngine:
                     raise ValueError(f"unknown cb program {which!r}")
             self.stats.count("compiles")
             self._compiled[key] = compiled
+            perf.harvest(key[0], compiled)
+            # analytic MemoryWatch component: the pool spec carries
+            # the exact shapes init_pools allocates
+            perf.set_memory_tree("kv_pool", pools,
+                                 scope=self._perf_scope)
             return compiled
 
     def run_cb_prefill(self, params, pools, tokens: np.ndarray,
@@ -709,12 +733,16 @@ class InferenceEngine:
         `pools` was donated; callers must use the returned tree."""
         self._maybe_stall()
         compiled = self._compile_cb("prefill")
+        t0 = time.perf_counter()
         tok0, pools = compiled(params, pools,
                                jnp.asarray(tokens, jnp.int32),
                                jnp.int32(plen),
                                jnp.asarray(row, jnp.int32),
                                self._next_key())
-        return int(tok0), pools
+        tok0 = int(tok0)
+        perf.observe_step("cb_prefill", time.perf_counter() - t0)
+        perf.mark_serving_ready()      # first warm token (latch)
+        return tok0, pools
 
     def run_cb_decode(self, params, pools, tokens: np.ndarray,
                       ntoks: np.ndarray, tables: np.ndarray):
@@ -722,21 +750,26 @@ class InferenceEngine:
         tokens on host, new pools).  `pools` was donated."""
         self._maybe_stall()
         compiled = self._compile_cb("decode")
+        t0 = time.perf_counter()
         nxt, pools = compiled(params, pools,
                               jnp.asarray(tokens, jnp.int32),
                               jnp.asarray(ntoks, jnp.int32),
                               jnp.asarray(tables, jnp.int32),
                               self._next_key())
-        return np.asarray(nxt), pools
+        nxt = np.asarray(nxt)
+        perf.observe_step("cb_decode", time.perf_counter() - t0)
+        return nxt, pools
 
     def _compile(self, mode: str, batch: int, prompt_len: int):
         key = (mode, batch, prompt_len)
         got = self._compiled.get(key)
         if got is not None:
+            perf.lookup_hit(mode)
             return got
         with self._compile_lock:
             got = self._compiled.get(key)
             if got is not None:
+                perf.lookup_hit(mode)
                 return got
             if self._params is None:
                 raise RuntimeError("engine has no params; call load()")
@@ -744,7 +777,11 @@ class InferenceEngine:
                 raise ValueError(f"unknown mode {mode!r}; modes are "
                                  f"{MODES}")
             with obs.span("engine.compile", mode=mode, batch=batch,
-                          plen=prompt_len):
+                          plen=prompt_len), \
+                 perf.compile_span(mode,
+                                   geometry=f"b{batch}_p{prompt_len}",
+                                   scope=self._perf_scope,
+                                   family=mode):
                 p_spec = jax.tree_util.tree_map(
                     lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
                     self._params)
@@ -762,6 +799,7 @@ class InferenceEngine:
                                                  pl).compile()
             self.stats.count("compiles")
             self._compiled[key] = compiled
+            perf.harvest(mode, compiled)
             return compiled
 
     def warmup(self, modes=("generate",)) -> int:
@@ -779,7 +817,22 @@ class InferenceEngine:
                 continue
             for b, p in self.spec.buckets:
                 self._compile(mode, b, p)
+        for mode in modes:
+            # from here on, a compile in this engine's scope for a
+            # warmed mode family is a perf.recompile_anomaly
+            perf.mark_warm(self._perf_scope, mode)
         return self.stats.compiles - before
+
+    def harvest_costs(self) -> int:
+        """CostWatch sweep: re-harvest `cost_analysis()` off every
+        already-compiled executable.  Reads cached objects only —
+        never lowers or compiles — so `stats.compiles` is unchanged
+        (the --perf-smoke gate).  Returns programs harvested."""
+        with self._compile_lock:
+            items = list(self._compiled.items())
+        for key, compiled in items:
+            perf.harvest(key[0], compiled)
+        return len(items)
 
     # -- execution ----------------------------------------------------------
     def set_stall(self, seconds: float) -> None:
@@ -825,8 +878,13 @@ class InferenceEngine:
             compiled = self._compile(mode, b, p)
             tokens = jnp.asarray(tokens, jnp.int32)
             plens = jnp.asarray(plens, jnp.int32)
+            t0 = time.perf_counter()
             if mode == "generate":
                 out = compiled(params, tokens, plens, self._next_key())
             else:
                 out = compiled(params, tokens, plens)
-        return np.asarray(out)
+            out = np.asarray(out)
+            perf.observe_step(mode, time.perf_counter() - t0)
+            if mode == "generate":
+                perf.mark_serving_ready()   # first warm token (latch)
+        return out

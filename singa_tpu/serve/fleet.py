@@ -688,10 +688,16 @@ class FleetServer:
     def __init__(self, fleet: EngineFleet, host: str = "127.0.0.1",
                  port: int = 0, log_fn=print):
         from ..obs.metrics import MetricsRegistry
+        from ..obs import perf
         self.fleet = fleet
         self.log = log_fn
         self.metrics = MetricsRegistry()
         self.fleet.router.stats.register_into(self.metrics)
+        # performance observatory + process-level collector: the fleet
+        # frontend exports the same compile/HBM/RSS surface as every
+        # other /metrics endpoint
+        perf.register_into(self.metrics)
+        perf.register_process_into(self.metrics)
         # durable-stream session counters (singa_stream_*): failover /
         # splice / dedupe visibility next to the fleet counters
         self.fleet.router.sessions.stats.register_into(self.metrics)

@@ -60,6 +60,22 @@ def init_pools(net, num_blocks: int, block_len: int,
     return pools
 
 
+def pool_bytes(net, num_blocks: int, block_len: int,
+               dtype=jnp.float32) -> int:
+    """Analytic byte count of the pools `init_pools` would allocate —
+    k and v per kAttention layer, (num_blocks, Hkv, block_len, D)
+    each.  MemoryWatch's HBM fallback on backends that expose no
+    `memory_stats()` (the CPU test platform) uses this, so it must
+    track `init_pools` shape-for-shape."""
+    elems = 0
+    for name in net.topo:
+        layer = net.layers[name]
+        if layer.cfg.type != "kAttention":
+            continue
+        elems += 2 * num_blocks * layer.kv_heads * block_len * layer.head_dim
+    return elems * int(np.dtype(dtype).itemsize)
+
+
 class PagedKVCache:
     """Block pool + slot tables for one serving engine.  Single-owner:
     the `ContinuousScheduler` thread is the only mutator, so the

@@ -210,6 +210,15 @@ class ContinuousScheduler:
                 block_len=spec.cb_block_len, dtype=dtype)
             self.stats.gauge("cb_slot_capacity", spec.cb_slots)
             self.stats.gauge("cb_blocks_total", self.kv.usable_blocks)
+            # MemoryWatch: the pools just allocated, from the same
+            # block geometry init_pools used (analytic == actual here)
+            from ..obs import perf
+            from .kvcache import pool_bytes
+            perf.set_memory(
+                "kv_pool",
+                pool_bytes(self.engine.net, spec.cb_pool_blocks,
+                           spec.cb_block_len, dtype),
+                scope=getattr(self.engine, "_perf_scope", "scheduler"))
         self._stop = False
         self._thread = threading.Thread(target=self._loop,
                                         name="serve-cb", daemon=True)

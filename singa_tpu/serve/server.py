@@ -62,6 +62,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from .. import obs
+from ..obs import perf
 from ..obs.metrics import MetricsRegistry
 from . import qos
 from .batcher import DeadlineExpired, MicroBatcher, Overloaded
@@ -93,6 +94,11 @@ class InferenceServer:
         # get their own) backing the /metrics Prometheus endpoint
         self.metrics = MetricsRegistry()
         self.stats.register_into(self.metrics)
+        # performance observatory (compiles/HBM/cost/readiness) + the
+        # process-level collector (RSS/threads/fds/uptime) export on
+        # every /metrics endpoint — a leaking engine must be visible
+        perf.register_into(self.metrics)
+        perf.register_process_into(self.metrics)
         self._host, self._port = host, port
         self._http_wanted = http
         self._warmup_modes = tuple(warmup_modes)

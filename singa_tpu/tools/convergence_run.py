@@ -73,8 +73,11 @@ def run(conf: str, target: float, max_steps: int, out: str,
     # evals, excluding only XLA compilation)
     warm = [next(train_iter) for _ in range(chunk)]
     warm_stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *warm)
-    trainer.train_steps.lower(params, opt_state, warm_stacked, 0, rng,
-                              chunk, True).compile()
+    # through the trainer's AOT cache: CompileWatch times the compile,
+    # CostWatch harvests it, and profile_phases-style consumers reuse
+    # the same executable instead of compiling their own
+    trainer.compiled_scan(params, opt_state, warm_stacked, 0, rng,
+                          chunk, True)
     while step < max_steps:
         n = min(chunk, max_steps - step)
         batches = ([next(train_iter) for _ in range(n)]

@@ -41,17 +41,43 @@ def peak_flops(device=None) -> Optional[float]:
     return PEAK_FLOPS.get(getattr(device, "device_kind", ""))
 
 
+def cost_metrics(compiled) -> Dict[str, float]:
+    """Harvest XLA's cost analysis from an ALREADY-COMPILED executable
+    (`jit(...).lower(...).compile()` result).  Never lowers or
+    compiles anything — reading the cost model off a cached executable
+    is free, which is what lets CostWatch run against every warm
+    program without perturbing the compile counters it also watches.
+
+    Returns {} when the backend reports nothing; otherwise a dict with
+    whatever of `flops` / `bytes accessed` / `utilization` keys the
+    cost model provides (older jax wraps the dict in a list)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 — diagnostics, never a failure
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return {}
+    return {k: float(v) for k, v in ca.items()
+            if isinstance(v, (int, float))}
+
+
 def compiled_flops(jitted, *args, **kwargs) -> Optional[float]:
     """FLOPs of the compiled XLA program for `jitted(*args)`.
 
-    `jitted` must be a jax.jit-wrapped callable.  Returns None when the
-    backend's cost model does not report flops.
+    `jitted` is either a jax.jit-wrapped callable (lowered and
+    compiled here, at compile cost) or an already-compiled executable
+    from `jit(...).lower(...).compile()` — the latter is preferred
+    when one is at hand: harvesting from the cached object never
+    triggers a duplicate compile.  Returns None when the backend's
+    cost model does not report flops.
     """
-    compiled = jitted.lower(*args, **kwargs).compile()
-    ca = compiled.cost_analysis()
-    if isinstance(ca, (list, tuple)):  # older jax returns [dict]
-        ca = ca[0] if ca else {}
-    flops = (ca or {}).get("flops")
+    if hasattr(jitted, "cost_analysis"):   # Compiled (or Lowered):
+        compiled = jitted                  # reuse, don't recompile
+    else:
+        compiled = jitted.lower(*args, **kwargs).compile()
+    flops = cost_metrics(compiled).get("flops")
     return float(flops) if flops and flops > 0 else None
 
 

@@ -16,9 +16,18 @@ present but missing gates — or recording a failed one — makes the
 report exit non-zero.  A green table over a gateless artifact reads as
 "the acceptance bar held" when nothing was checked.
 
-Usage: python tools/bench_report.py [repo_root]
+`--trajectory` turns the so-far-unused bench trajectory into a gate:
+one markdown table of tracked metrics across every BENCH_pr*.json in
+PR order, with per-PR deltas against the previous artifact that
+carried the same key.  A tracked key that degrades past its tolerance
+(TREND_TOL — a loose order-of-magnitude guard, since adjacent PRs
+bench different workloads), ANY artifact recording a failed gate, and
+ANY unreadable artifact all exit non-zero in this mode.
+
+Usage: python tools/bench_report.py [--trajectory] [repo_root]
 Exit status: 0 unless a REQUIRED_GATES artifact is present with
-missing or failing gates.
+missing or failing gates (plus the stricter trajectory failures
+above when --trajectory is given).
 """
 
 import glob
@@ -58,6 +67,36 @@ REQUIRED_GATES = {
         "trace_orphan_spans", "stage_attribution_err",
         "flightrec_replayed", "trace_overhead",
     ),
+    "BENCH_pr15.json": (
+        "warmup_cb_compiles", "post_warmup_compiles",
+        "recompile_anomalies", "restart_to_serving",
+        "restart_to_training", "hbm_watermark",
+        "costwatch_compiles", "obs_overhead", "trajectory_renders",
+    ),
+}
+
+# --trajectory: tracked keys -> (direction, tolerance factor).  The
+# comparison is consecutive-occurrence across PR artifacts, which mixes
+# workloads (pr5's serve smoke vs pr7's fleet smoke both report
+# p95_latency_ms), so the tolerance is a loose multiplicative guard
+# against order-of-magnitude regressions, not cross-workload noise.
+TREND_TOL = {
+    "p50_latency_ms": ("lower", 3.0),
+    "p95_latency_ms": ("lower", 3.0),
+    "p95_ms": ("lower", 3.0),
+    "p99_ms": ("lower", 3.0),
+    "interactive_p95_ms": ("lower", 3.0),
+    "tok_sec": ("higher", 3.0),
+    "qps": ("higher", 3.0),
+    "hedge_rate": ("lower", 3.0),
+    "retry_amplification": ("lower", 2.0),
+    "shed_rate": ("lower", 3.0),
+    "obs_overhead": ("lower", None),        # shown, never gated: a
+    "trace_overhead": ("lower", None),      # near-zero base makes any
+    "restart_to_serving_s": ("lower", None),  # ratio meaningless
+    "restart_to_training_s": ("lower", None),
+    "hbm_watermark_bytes": ("lower", 4.0),
+    "mfu": ("higher", 3.0),
 }
 
 
@@ -137,9 +176,106 @@ def report(root=".", problems=None) -> str:
     return "\n".join(lines)
 
 
+def _tracked(d):
+    """{tracked_key: value} for one artifact: top-level keys named in
+    TREND_TOL, one-level-nested dict keys (`cb.p95_ms` tracks as
+    `p95_ms` only when the top level has none), and the artifact's
+    headline `value` filed under its `metric` name when that name is
+    tracked (pr6's obs_overhead artifact)."""
+    out = {}
+    metric = d.get("metric")
+    if metric in TREND_TOL and isinstance(d.get("value"), (int, float)):
+        out[metric] = float(d["value"])
+    for k, v in d.items():
+        if k in TREND_TOL and isinstance(v, (int, float)):
+            out[k] = float(v)
+    for k, v in d.items():
+        if isinstance(v, dict):
+            for kk, vv in v.items():
+                if (kk in TREND_TOL and kk not in out
+                        and isinstance(vv, (int, float))):
+                    out[kk] = float(vv)
+    return out
+
+
+def _regressed(key, prev, cur):
+    """The failure string when cur is past tolerance vs prev, else
+    None.  Keys with a None factor are reported but never gated."""
+    direction, factor = TREND_TOL[key]
+    if factor is None or prev is None:
+        return None
+    if direction == "lower" and prev > 0 and cur > prev * factor:
+        return (f"`{key}` regressed {cur / prev:.2f}x "
+                f"({_fmt(prev)} -> {_fmt(cur)}, tolerance {factor}x)")
+    if direction == "higher" and cur > 0 and prev > cur * factor:
+        return (f"`{key}` regressed {prev / cur:.2f}x "
+                f"({_fmt(prev)} -> {_fmt(cur)}, tolerance {factor}x)")
+    return None
+
+
+def trajectory(root=".", problems=None) -> str:
+    """Per-PR trajectory table over every BENCH_pr*.json; see module
+    docstring for what lands in `problems`."""
+    if problems is None:
+        problems = []
+    arts = []
+    for path in glob.glob(os.path.join(root, "BENCH_pr*.json")):
+        name = os.path.basename(path)
+        m = re.search(r"BENCH_pr(\d+)\.json$", name)
+        pr = int(m.group(1)) if m else -1
+        try:
+            with open(path) as f:
+                d = json.loads(f.readline())
+        except (OSError, ValueError) as e:
+            problems.append(f"{name}: unreadable "
+                            f"({type(e).__name__}: {e})")
+            continue
+        arts.append((pr, name, d))
+    arts.sort()
+    lines = ["| PR | artifact | metric | value | trend (Δ vs last "
+             "carrier) | gates |",
+             "|---:|----------|--------|------:|---------"
+             "|-------|"]
+    last_seen = {}                   # key -> (pr, value)
+    for pr, name, d in arts:
+        problems.extend(_check_gates(name, d))
+        gates = d.get("gates")
+        if isinstance(gates, dict):
+            for g, rec in gates.items():
+                if isinstance(rec, dict) and not rec.get("pass"):
+                    problems.append(f"{name}: gate `{g}` FAILED "
+                                    f"({_fmt(rec.get('value'))} not "
+                                    f"{rec.get('op', '?')} "
+                                    f"{_fmt(rec.get('bound'))})")
+        cells = []
+        for key, val in sorted(_tracked(d).items()):
+            prev = last_seen.get(key)
+            delta = ""
+            if prev is not None and prev[1]:
+                pct = (val - prev[1]) / abs(prev[1]) * 100.0
+                delta = f" ({pct:+.0f}% vs pr{prev[0]})"
+                bad = _regressed(key, prev[1], val)
+                if bad:
+                    problems.append(f"{name}: {bad}")
+            cells.append(f"{key}={_fmt(val)}{delta}")
+            last_seen[key] = (pr, val)
+        gs = _gate_summary(name, d) or ""
+        lines.append(f"| {pr} | {name} | {d.get('metric', '?')} "
+                     f"| {_fmt(d.get('value', '?'))} "
+                     f"| {'; '.join(cells)} | {gs} |")
+    if len(lines) == 2:
+        lines.append("| - | (no BENCH_pr*.json found) | | | | |")
+    return "\n".join(lines)
+
+
 def main(argv):
+    args = [a for a in argv[1:] if a != "--trajectory"]
     problems = []
-    print(report(argv[1] if len(argv) > 1 else ".", problems))
+    root = args[0] if args else "."
+    if "--trajectory" in argv:
+        print(trajectory(root, problems))
+    else:
+        print(report(root, problems))
     if problems:
         for p in problems:
             print(f"GATE PROBLEM: {p}", file=sys.stderr)
