@@ -2341,6 +2341,205 @@ def bench_trace_smoke(out=None):
     return result
 
 
+def bench_tenant_smoke(out=None):
+    """ISSUE 18 acceptance: two tenants share ONE engine (no
+    autoscaler — isolation must come from quotas, not from capacity
+    chasing the spike) and the run FAILS (raises) unless:
+      * tenant A's flash crowd (open-loop, >= 5x B's offered rate)
+        leaves tenant B untouched: B's flash-phase p95 stays within
+        1.2x its quiet-phase p95 and B completes 100% of its offered
+        requests with zero sheds — A's overload is A's problem;
+      * A's overflow is shed honestly (Overloaded) and consecutive
+        sheds carry per-tenant ESCALATING Retry-After — the backpres-
+        sure signal a well-behaved client needs to back off;
+      * the per-tenant retry-budget floor holds: with A's budget and
+        the shared bucket drained dry, B can still spend from its
+        guaranteed floor while A cannot — one tenant's retry storm
+        cannot starve another's hedges;
+      * zero non-shed failures and zero harness drops.
+    Records per-phase per-tenant offered/completed/shed/p95, the
+    observed Retry-After ladder, and the budget-floor outcome; `out`
+    writes the JSON line to a file as well
+    (scripts/tenant_smoke.sh -> BENCH_pr18.json)."""
+    import tempfile
+    import threading
+
+    import jax
+
+    from singa_tpu.core.net import build_net
+    from singa_tpu.models.transformer import transformer_lm
+    from singa_tpu.serve import (EngineFleet, Overloaded, RouterSpec,
+                                 ServeSpec, TenantRegistry)
+    from singa_tpu.serve.traffic import TrafficGen, steady
+    from singa_tpu.utils.checkpoint import CheckpointManager
+
+    vocab, seq = 64, 16
+    cfg = transformer_lm(vocab_size=vocab, num_layers=2, embed_dim=32,
+                         num_heads=4, head_dim=8, seq_len=seq,
+                         batchsize=2)
+    net = build_net(cfg, "kTest",
+                    {"data": {"input": (seq,), "target": (seq,)}})
+    params = net.init_params(jax.random.PRNGKey(0))
+
+    ws = tempfile.mkdtemp(prefix="tenant_smoke_")
+    mgr = CheckpointManager(ws, log_fn=lambda s: None)
+    mgr.save(1, params, {"t": np.zeros(())}, health={"verdict": "ok"})
+
+    # hard partition: each tenant gets 1 of the 2 cb slots and its
+    # own queue carve-out, so A flooding ITS queue cannot touch B's
+    spec = ServeSpec(buckets=((2, 16),), max_new_tokens=24,
+                     batch_window_s=0.002, request_timeout_s=30.0,
+                     queue_capacity=8, cb="on", cb_slots=2,
+                     cb_block_len=8)
+    reg = TenantRegistry.parse(
+        "a,queue_frac=0.25,slot_frac=0.5,kv_frac=0.5,budget_floor=4,"
+        "brownout_batch_frac=0.125;"
+        "b,queue_frac=0.5,slot_frac=0.5,kv_frac=0.5,budget_floor=4")
+    fleet = EngineFleet.local(
+        net, spec, 1, workspace=ws, params=params,
+        router_spec=RouterSpec(probe_period_s=0.05,
+                               quarantine_after=3),
+        tenancy=reg, log_fn=lambda s: None)
+    fleet.start()
+
+    gen = TrafficGen(
+        lambda toks, **kw: fleet.generate(toks.tolist(), **kw),
+        vocab=vocab, seed=0, log_fn=lambda s: None)
+    # quiet: both tenants well inside one engine's capacity; flash:
+    # A jumps to ~10x B's rate (>= 5x its own quiet share) while B
+    # keeps its quiet cadence
+    phases = [steady("quiet", 8.0, 4.0, prompt_lens=(4, 8),
+                     tenants=("a", "b"),
+                     tenant_weights=(1.0, 1.0)),
+              steady("flash", 10.0, 44.0, prompt_lens=(4, 8),
+                     tenants=("a", "b"),
+                     tenant_weights=(10.0, 1.0))]
+    rep = gen.run(phases, drain_timeout_s=30.0)
+
+    # -- Retry-After escalation sub-test ------------------------------
+    # A's spec tightened its own batch brownout to 0.125 (shed batch
+    # whenever the queue is non-empty), so while interactive fillers
+    # keep the queue occupied, A's batch probes shed CONSECUTIVELY —
+    # their (tenant, class) streak never resets, and each shed's
+    # Retry-After must climb the per-(tenant, class) ladder.
+    stop_fill = threading.Event()
+
+    def _fill():
+        while not stop_fill.is_set():
+            try:
+                fleet.generate(list(range(1, 9)), tenant="a",
+                               timeout=10.0)
+            except Exception:  # noqa: BLE001 — filler sheds are the
+                pass           # pressure, not the measurement
+
+    fillers = [threading.Thread(target=_fill, daemon=True)
+               for _ in range(8)]
+    for t in fillers:
+        t.start()
+    time.sleep(0.3)                      # let the queue fill
+    retry_afters = []
+    for _ in range(10):
+        try:
+            fleet.generate([1, 2, 3, 4], tenant="a",
+                           priority="batch", timeout=10.0)
+        except Overloaded as e:
+            retry_afters.append(float(e.retry_after))
+        except Exception:  # noqa: BLE001 — budget stops etc. don't
+            pass           # carry a Retry-After; only sheds gate
+        time.sleep(0.02)
+    stop_fill.set()
+    for t in fillers:
+        t.join(15.0)
+    esc_ratio = (max(retry_afters) / max(min(retry_afters), 1e-9)
+                 if len(retry_afters) >= 5 else 0.0)
+
+    # -- budget-floor sub-test ----------------------------------------
+    # drain A's budget AND the shared bucket through A, then B must
+    # still be able to spend from its guaranteed floor while A is dry
+    ba = fleet.tenancy.budget("a")
+    bb = fleet.tenancy.budget("b")
+    drained = 0
+    while ba.spend() and drained < 10_000:
+        drained += 1
+    b_admitted = bool(bb.spend())
+    a_exhausted = not ba.spend()
+    fleet.stop()
+
+    tot = rep["totals"]
+    quiet = next(r for r in rep["phases"] if r["name"] == "quiet")
+    flash = next(r for r in rep["phases"] if r["name"] == "flash")
+    qb = quiet["by_tenant"].get("b", {})
+    fb = flash["by_tenant"].get("b", {})
+    fa = flash["by_tenant"].get("a", {})
+    tb = tot["by_tenant"].get("b", {})
+    b_p95_ratio = (fb["p95_ms"] / qb["p95_ms"]
+                   if fb.get("p95_ms") and qb.get("p95_ms") else 0.0)
+    b_completion = (tb.get("completed", 0) / tb["offered"]
+                    if tb.get("offered") else 0.0)
+    a_vs_b_offered = (fa.get("offered", 0) / fb["offered"]
+                      if fb.get("offered") else 0.0)
+
+    gates = {
+        "tenant_b_p95_isolated": {
+            "value": round(b_p95_ratio, 4), "bound": 1.2,
+            "op": "<=", "pass": bool(0.0 < b_p95_ratio <= 1.2)},
+        "tenant_b_completion": {
+            "value": round(b_completion, 4), "bound": 1.0,
+            "op": ">=", "pass": bool(b_completion >= 1.0)},
+        "tenant_b_zero_shed": {
+            "value": tb.get("shed", 0), "bound": 0, "op": "==",
+            "pass": bool(tb.get("shed", 0) == 0)},
+        "tenant_a_overloaded": {
+            "value": round(a_vs_b_offered, 2), "bound": 5.0,
+            "op": ">=", "pass": bool(a_vs_b_offered >= 5.0)},
+        "tenant_a_shed_overflow": {
+            "value": fa.get("shed", 0), "bound": 1, "op": ">=",
+            "pass": bool(fa.get("shed", 0) >= 1)},
+        "tenant_a_retry_escalation": {
+            "value": round(esc_ratio, 2), "bound": 1.5, "op": ">=",
+            "pass": bool(esc_ratio >= 1.5)},
+        "budget_floor_b_admitted": {
+            "value": int(b_admitted), "bound": 1, "op": "==",
+            "pass": bool(b_admitted)},
+        "budget_floor_a_exhausted": {
+            "value": int(a_exhausted), "bound": 1, "op": "==",
+            "pass": bool(a_exhausted)},
+        "zero_failures": {
+            "value": tot["failed"], "bound": 0, "op": "==",
+            "pass": bool(tot["failed"] == 0)},
+        "zero_harness_drops": {
+            "value": tot["dropped_harness"], "bound": 0, "op": "==",
+            "pass": bool(tot["dropped_harness"] == 0)},
+    }
+    failures = [f"{k}: {g['value']} not {g['op']} {g['bound']}"
+                for k, g in gates.items() if not g["pass"]]
+    if failures:
+        raise RuntimeError("tenant smoke FAILED: "
+                           + "; ".join(failures)
+                           + f" (errors={tot['errors'][:3]})")
+
+    result = {
+        "metric": "tenant_smoke_b_p95_isolation_ratio",
+        "value": round(b_p95_ratio, 4),
+        "unit": "flash_p95_over_quiet_p95",
+        "quiet": {"offered": quiet["offered"],
+                  "by_tenant": quiet["by_tenant"]},
+        "flash": {"offered": flash["offered"],
+                  "by_tenant": flash["by_tenant"]},
+        "totals_by_tenant": tot["by_tenant"],
+        "retry_afters": [round(r, 4) for r in retry_afters],
+        "retry_escalation_ratio": round(esc_ratio, 2),
+        "budget_drained_through_a": drained,
+        "gates": gates,
+        "backend": jax.default_backend(),
+    }
+    line = json.dumps(result)
+    if out:
+        with open(out, "w") as f:
+            f.write(line + "\n")
+    return result
+
+
 def main() -> None:
     if "--cpu-baseline" in sys.argv:
         bench_cpu_baseline()
@@ -2398,6 +2597,12 @@ def main() -> None:
         if "--out" in sys.argv:
             out = sys.argv[sys.argv.index("--out") + 1]
         print(json.dumps(bench_trace_smoke(out=out)))
+        return
+    if "--tenant-smoke" in sys.argv:
+        out = None
+        if "--out" in sys.argv:
+            out = sys.argv[sys.argv.index("--out") + 1]
+        print(json.dumps(bench_tenant_smoke(out=out)))
         return
     if "--obs-overhead" in sys.argv:
         out = None

@@ -1,0 +1,73 @@
+#!/usr/bin/env bash
+# Tenant-isolation smoke (ISSUE 18 acceptance): two tenants share ONE
+# engine and one tenant's flash crowd must stay that tenant's
+# problem, on CPU.  FAILS unless
+#   * tenant B's flash-phase p95 stays within 1.2x its quiet-phase
+#     p95 and B completes 100% of its offered requests with zero
+#     sheds while tenant A floods at >= 5x B's rate;
+#   * A's overflow is shed honestly (Overloaded) with a per-tenant
+#     ESCALATING Retry-After across consecutive sheds;
+#   * the per-tenant retry-budget floor holds: A draining its budget
+#     and the shared bucket dry leaves B still able to spend from
+#     its guaranteed floor;
+#   * zero non-shed failures and zero harness drops.
+# Writes BENCH_pr18.json (per-phase per-tenant offered/completed/
+# shed/p95, the Retry-After ladder, the budget-floor outcome).
+#
+# Usage: scripts/tenant_smoke.sh        (CPU-only, no data, ~1 min)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+# Leg 1: the bench smoke — quiet -> tenant-A flash crowd over a
+# quota-partitioned 1-engine fleet.  bench_tenant_smoke raises (and
+# this script fails) unless every acceptance bullet holds.
+python bench.py --tenant-smoke --out BENCH_pr18.json
+
+# the recorded artifact must actually carry the numbers, not nulls
+python - <<'EOF'
+import json
+with open("BENCH_pr18.json") as f:
+    d = json.loads(f.read())
+assert isinstance(d.get("value"), (int, float)), d.get("value")
+assert 0.0 < d["value"] <= 1.2, d["value"]
+fb = d["flash"]["by_tenant"]["b"]
+fa = d["flash"]["by_tenant"]["a"]
+assert fb["completed"] == fb["offered"] and fb["shed"] == 0, fb
+assert fa["shed"] >= 1, fa
+assert d["retry_escalation_ratio"] >= 1.5, d["retry_escalation_ratio"]
+g = d["gates"]
+assert g["budget_floor_b_admitted"]["pass"], g
+assert g["budget_floor_a_exhausted"]["pass"], g
+print(f"BENCH_pr18.json ok: B p95 ratio={d['value']} (bound 1.2), "
+      f"B {fb['completed']}/{fb['offered']} completed, "
+      f"A shed={fa['shed']}/{fa['offered']}, "
+      f"retry escalation x{d['retry_escalation_ratio']}")
+EOF
+echo "TENANT BENCH PASS: A's flash crowd stayed A's problem — B's"
+echo "  p95 and completion untouched, budget floor held"
+
+# Leg 2: the regression suite — registry grammar, quota enforcement,
+# budget floors, (tenant, class) streaks, label-cardinality bounds,
+# model-aware 404s, all on stubs.
+python -m pytest tests/test_tenancy.py -q -m tenancy \
+    -p no:cacheprovider
+
+# Leg 3: the CLI surface — `serve --fleet 1` with a --tenant_spec
+# publishes the tenancy envelopes and per-tenant counters in the
+# smoke summary.
+python -m singa_tpu.main serve -model_conf examples/transformer/lm.conf \
+    --fleet 1 --smoke 4 \
+    --serve_spec 'buckets=2x8,max_new_tokens=4,batch_window_s=0.005' \
+    --tenant_spec 'a,queue_frac=0.25,budget_floor=4;b,queue_frac=0.5' \
+    | grep -E '"tenancy"' > /dev/null || {
+        echo "TENANT SMOKE CLI LEG FAILED"; exit 1; }
+echo "TENANT SMOKE CLI PASS"
+
+# Leg 4: the report — every BENCH_pr*.json lands in one table and the
+# new artifact is in it.
+python tools/bench_report.py | grep -E 'BENCH_pr18' > /dev/null || {
+    echo "BENCH REPORT LEG FAILED"; exit 1; }
+python tools/bench_report.py
+echo "TENANT SMOKE PASS"

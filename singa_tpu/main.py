@@ -221,6 +221,16 @@ def make_serve_argparser() -> argparse.ArgumentParser:
                          "max_engines=4,cooldown_s=5,window_s=10' "
                          "(singa_tpu/serve/autoscale.py; needs "
                          "--fleet, not --fleet_hostfile)")
+    ap.add_argument("--tenant_spec", default=None,
+                    help="multi-tenant QoS envelopes: ';'-separated "
+                         "tenants, each 'name,key=value,...' over the "
+                         "TenantSpec fields, e.g. 'a,queue_frac=0.25,"
+                         "budget_floor=4;b,queue_frac=0.5' — quotas, "
+                         "retry-budget floors, and brownout overrides "
+                         "enforced per tenant at admission "
+                         "(singa_tpu/serve/tenancy.py, "
+                         "docs/SERVING.md); unnamed clients ride the "
+                         "unquota'd `default` tenant")
     ap.add_argument("--pinned", action="store_true",
                     help="run this engine as a fleet member: never "
                          "self-reload; only the rollout controller's "
@@ -275,6 +285,9 @@ def serve_main(argv) -> int:
         reg = obs.registry()
         if reg is not None:
             engine.stats.register_into(reg)
+        from .serve import TenantRegistry
+        tenancy = (TenantRegistry.parse(args.tenant_spec)
+                   if args.tenant_spec else None)
 
         with inject(schedule):
             if schedule is not None:
@@ -283,7 +296,7 @@ def serve_main(argv) -> int:
             server = InferenceServer(engine, host=args.host,
                                      port=args.port,
                                      http=(args.smoke == 0),
-                                     log_fn=log)
+                                     tenancy=tenancy, log_fn=log)
             server.start()
             if engine.params_step < 0:
                 log("warning: serving fresh-init params (no "
@@ -329,13 +342,16 @@ def _fleet_main(args, net, spec, fallback, schedule, log) -> int:
     import json as _json
 
     from .serve import (AutoScaler, AutoScaleSpec, EngineFleet,
-                        FleetServer, RolloutSpec, RouterSpec)
+                        FleetServer, RolloutSpec, RouterSpec,
+                        TenantRegistry)
     from .utils.faults import inject
 
     router_spec = RouterSpec.parse(args.fleet_spec)
     rollout_spec = RolloutSpec.parse(args.rollout_spec)
     autoscale_spec = (AutoScaleSpec.parse(args.autoscale_spec)
                       if args.autoscale_spec is not None else None)
+    tenancy = (TenantRegistry.parse(args.tenant_spec)
+               if args.tenant_spec else None)
     if args.pinned:
         log("warning: --pinned is a member flag; the fleet's workers "
             "are always pinned — ignoring")
@@ -347,12 +363,13 @@ def _fleet_main(args, net, spec, fallback, schedule, log) -> int:
             fleet = EngineFleet.from_hostfile(
                 args.fleet_hostfile, workspace=args.workspace,
                 router_spec=router_spec, rollout_spec=rollout_spec,
-                log_fn=log)
+                tenancy=tenancy, log_fn=log)
         else:
             fleet = EngineFleet.local(
                 net, spec, args.fleet, workspace=args.workspace,
                 params=fallback, router_spec=router_spec,
-                rollout_spec=rollout_spec, log_fn=log)
+                rollout_spec=rollout_spec, tenancy=tenancy,
+                log_fn=log)
         scaler = None
         if autoscale_spec is not None:
             if not fleet.can_grow():

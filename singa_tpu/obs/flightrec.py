@@ -53,6 +53,19 @@ TRIGGER_KINDS = {
 SHED_STORM_N = 16
 SHED_STORM_WINDOW_S = 5.0
 
+#: sheds ONE tenant must absorb inside the window for its own storm
+#: trigger.  Deliberately below SHED_STORM_N: a tenant's last-N sheds
+#: are a subset of history, so with an equal threshold the global
+#: window would always trip first and the per-tenant view could never
+#: fire.  The per-tenant storm additionally requires DILUTION — other
+#: tenants' sheds inside the global window — so a single-tenant burst
+#: still reads as the plain `shed_storm` it always was.
+SHED_TENANT_STORM_N = 12
+
+#: distinct tenants tracked for the per-tenant storm trigger; excess
+#: ids share one "other" window (bounded memory, like singa_tenant_*)
+SHED_TENANT_CAP = 64
+
 #: spans pulled from the tracer tail into each dump
 DUMP_SPANS = 256
 
@@ -76,6 +89,10 @@ class FlightRecorder:
         self.sheds_seen = 0
         self._ring: deque = deque(maxlen=max(int(ring), 16))
         self._shed_ts: deque = deque(maxlen=SHED_STORM_N)
+        # per-tenant shed windows: one tenant's storm is ITS incident
+        # (tenant_shed_storm) even when the global rate stays calm —
+        # the blast-radius view of the same signal
+        self._shed_ts_by_tenant: Dict[str, deque] = {}
         self._last_dump: Dict[str, float] = {}
         self._seq = itertools.count(1)
         self._lock = threading.Lock()
@@ -93,7 +110,8 @@ class FlightRecorder:
                 self._ring.append(rec)
             why = TRIGGER_KINDS.get(kind)
             if why is None and kind == "serve.shed":
-                why = self._observe_shed()
+                why = self._observe_shed(
+                    str(fields.get("tenant") or "default"))
             if why is None and str(
                     fields.get("verdict", fields.get("status", ""))
                     ).upper() == "DIVERGED":
@@ -105,15 +123,32 @@ class FlightRecorder:
             self.dump_failures += 1
             return None
 
-    def _observe_shed(self) -> Optional[str]:
+    def _observe_shed(self, tenant: str = "default") -> Optional[str]:
         now = time.monotonic()
         with self._lock:
             self.sheds_seen += 1
-            self._shed_ts.append(now)
+            self._shed_ts.append((now, tenant))
             full = len(self._shed_ts) == self._shed_ts.maxlen
-            stormy = (full and now - self._shed_ts[0]
+            stormy = (full and now - self._shed_ts[0][0]
                       <= SHED_STORM_WINDOW_S)
-        return "shed_storm" if stormy else None
+            tw = self._shed_ts_by_tenant.get(tenant)
+            if tw is None:
+                if len(self._shed_ts_by_tenant) >= SHED_TENANT_CAP:
+                    tenant = "other"
+                tw = self._shed_ts_by_tenant.setdefault(
+                    tenant, deque(maxlen=SHED_TENANT_STORM_N))
+            tw.append(now)
+            # diluted: the global window carries OTHER tenants' sheds
+            # too, so the fleet-wide counter under-reads this tenant's
+            # burst — exactly the blind spot the per-tenant view fills
+            t_stormy = (len(tw) == tw.maxlen
+                        and now - tw[0] <= SHED_STORM_WINDOW_S
+                        and any(tn != tenant
+                                for _, tn in self._shed_ts))
+        if stormy:
+            return "shed_storm"
+        # the fleet-wide storm wins (it subsumes the tenant view)
+        return "tenant_shed_storm" if t_stormy else None
 
     def trigger(self, why: str, tracer=None,
                 **context) -> Optional[str]:

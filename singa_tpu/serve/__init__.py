@@ -55,7 +55,13 @@ checkpoints via atomic hot-reload.  Layers:
                  deadline propagation (absolute in-process, remaining
                  -ms on the wire), priority classes interactive /
                  batch / best_effort, RetryBudget token bucket,
-                 per-class Retry-After backoffs
+                 per-(tenant, class) Retry-After backoffs
+    tenancy.py   TenantRegistry + TenantSpec + TenantBudget: per-
+                 tenant QoS envelopes — retry-budget floors, queue/
+                 slot/KV quotas, brownout overrides — with unknown
+                 tenant ids folded into one bounded `other` envelope
+                 (blast-radius containment for the multi-tenant
+                 fleet)
 
 Fault sites `serve.admit` / `serve.batch` / `serve.reload` /
 `fleet.dispatch` / `fleet.rollout` / `scale.decide` / `serve.hedge` /
@@ -78,8 +84,10 @@ from .router import (EngineUnavailable, HttpEngineHandle,
 from .scheduler import ContinuousScheduler, StreamTicket
 from .server import InferenceServer
 from .session import SessionManager, StreamSession, StreamStats
+from .router import UnknownModel
 from .stats import ServeStats
 from .qos import PRIORITIES, ClassBackoffs, RetryBudget
+from .tenancy import (TenantBudget, TenantRegistry, TenantSpec)
 from .traffic import (Phase, TrafficGen, diurnal, flash_crowd,
                       kill_chaos, ramp, stall_chaos, steady)
 
@@ -91,6 +99,7 @@ __all__ = ["AutoScaler", "AutoScaleSpec", "Cancelled",
            "PRIORITIES", "PagedKVCache", "Phase", "RetryBudget",
            "RolloutController", "RolloutSpec", "Router", "RouterSpec",
            "RouterStats", "ServeSpec", "ServeStats", "SessionManager",
-           "StreamSession", "StreamStats", "StreamTicket", "Ticket",
-           "TrafficGen", "diurnal", "flash_crowd", "kill_chaos",
-           "qos", "ramp", "stall_chaos", "steady"]
+           "StreamSession", "StreamStats", "StreamTicket",
+           "TenantBudget", "TenantRegistry", "TenantSpec", "Ticket",
+           "TrafficGen", "UnknownModel", "diurnal", "flash_crowd",
+           "kill_chaos", "qos", "ramp", "stall_chaos", "steady"]

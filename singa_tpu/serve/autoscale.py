@@ -228,6 +228,24 @@ class AutoScaler:
                     * float(self.spec.w_batch)
                     + by_class.get("best_effort", 0)
                     * float(self.spec.w_best_effort))
+        # TENANT-WEIGHTED on top of class-weighted: a shed charged to
+        # a quota-limited tenant counts only `share` (its queue_frac)
+        # of a shed from an unconstrained one — a tenant overflowing
+        # its OWN entitlement is blast-radius containment working,
+        # not a reason to buy fleet-wide capacity.  The discount is
+        # the share-weighted mean over the window's sheds; with no
+        # tenancy configured every share is 1.0 and the factor is 1.0
+        # (legacy control law unchanged).
+        tenant_factor = 1.0
+        by_tenant = win.get("shed_by_tenant") or {}
+        total_t = sum(by_tenant.values())
+        reg = getattr(getattr(self.fleet, "router", None),
+                      "tenancy", None)
+        if total_t > 0 and reg is not None:
+            tw = sum(cnt * float(reg.share(t))
+                     for t, cnt in by_tenant.items())
+            tenant_factor = tw / total_t
+        weighted *= tenant_factor
         p95_cls = (win.get("p95_by_class") or {}).get("interactive")
         return {
             "n": len(members),
@@ -237,6 +255,7 @@ class AutoScaler:
                                for m in members),
             "shed_rate": round(weighted / max(win["routed"], 1), 4),
             "shed_rate_raw": win["shed_rate"],
+            "tenant_shed_factor": round(tenant_factor, 4),
             "qps": win["qps"],
             "p95_ms": (p95_cls if p95_cls is not None
                        else win["p95_latency_ms"]),

@@ -42,6 +42,7 @@ from ..utils import faults
 from . import qos
 from .engine import InferenceEngine
 from .stats import ServeStats
+from .tenancy import TenantRegistry
 
 
 class Overloaded(RuntimeError):
@@ -101,6 +102,7 @@ class _Request:
     t_submit: float
     deadline: Optional[float]     # monotonic, None = no deadline
     priority: str = "interactive"
+    tenant: str = "default"       # registry-folded tenant label
     cancel_event: Optional[threading.Event] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
@@ -111,11 +113,16 @@ class MicroBatcher:
 
     def __init__(self, engine: InferenceEngine,
                  stats: Optional[ServeStats] = None, log_fn=print,
-                 backoff: Optional[faults.Backoff] = None):
+                 backoff: Optional[faults.Backoff] = None,
+                 tenancy: Optional[TenantRegistry] = None):
         self.engine = engine
         self.spec = engine.spec
         self.stats = stats if stats is not None else engine.stats
         self.log = log_fn
+        # per-tenant queue quotas + brownout overrides (an
+        # unconfigured registry is all-default: no quota, engine
+        # fractions — exact legacy admission)
+        self.tenancy = tenancy or TenantRegistry()
         self._backoff = backoff if backoff is not None else \
             faults.Backoff(base=0.05, cap=2.0, seed=self.spec.seed)
         self._q: deque = deque()
@@ -168,7 +175,8 @@ class MicroBatcher:
                timeout: Optional[float] = None,
                deadline: Optional[float] = None,
                priority: str = "interactive",
-               cancel_event: Optional[threading.Event] = None) -> Ticket:
+               cancel_event: Optional[threading.Event] = None,
+               tenant: Optional[str] = None) -> Ticket:
         """Admit one request.  `tokens` is a 1-D int32 prompt;
         `deadline` (absolute monotonic; wins over `timeout`, which
         still derives one: spec.request_timeout_s default, <=0 = none)
@@ -176,10 +184,13 @@ class MicroBatcher:
         before it queues (`expired_on_arrival`).  `priority`
         (serve/qos.py classes) drives brownout: under queue pressure
         lower classes shed first with an honest per-class Retry-After.
-        `cancel_event`, when set by the caller, drops the request at
-        the next gather (counted `cancelled`).  Raises `Overloaded`
-        (with `retry_after`) on shed; ValueError for an unservable
-        prompt or unknown priority."""
+        `tenant` (folded through the registry; None = `default`)
+        enforces the tenant's queue quota and scopes its Retry-After
+        streak — one tenant filling its quota sheds ITS overflow, not
+        a neighbor's traffic.  `cancel_event`, when set by the caller,
+        drops the request at the next gather (counted `cancelled`).
+        Raises `Overloaded` (with `retry_after`) on shed; ValueError
+        for an unservable prompt or unknown priority."""
         arr = np.asarray(tokens, np.int32).reshape(-1)
         if arr.size < 1:
             self.stats.count("rejected")
@@ -200,6 +211,7 @@ class MicroBatcher:
         except ValueError:
             self.stats.count("rejected")
             raise
+        tenant = self.tenancy.label(tenant)
         deadline = qos.resolve_deadline(timeout, deadline,
                                         self.spec.request_timeout_s)
         now = time.monotonic()
@@ -214,54 +226,72 @@ class MicroBatcher:
         req = _Request(tokens=arr, plen=int(arr.size), mode=mode,
                        ticket=Ticket(), t_submit=now,
                        deadline=deadline, priority=priority,
-                       cancel_event=cancel_event,
+                       tenant=tenant, cancel_event=cancel_event,
                        extra={"corr": corr})
         with obs.span("batcher.admit", corr=corr, mode=mode,
-                      plen=int(arr.size), priority=priority):
+                      plen=int(arr.size), priority=priority,
+                      tenant=tenant):
             try:
                 faults.maybe_fault("serve.admit")
             except faults.FaultError as e:
                 return self._shed(f"admission fault: {e}", corr=corr,
-                                  priority=priority)
+                                  priority=priority, tenant=tenant)
+            quota = self.tenancy.queue_quota(
+                tenant, self.spec.queue_capacity)
             with self._cv:
                 if self._stop:
                     raise RuntimeError("batcher is stopped")
                 depth = len(self._q)
+                tdepth = sum(1 for r in self._q if r.tenant == tenant)
                 if depth >= self.spec.queue_capacity or \
-                        not self._brownout_admits(priority, depth):
+                        tdepth >= quota or \
+                        not self._brownout_admits(priority, depth,
+                                                  tenant):
                     pass  # shed outside the lock's happy path below
                 else:
                     self._q.append(req)
-                    self._class_backoffs.reset(priority)
+                    self._class_backoffs.reset(priority, tenant=tenant)
                     self.stats.count("submitted")
+                    self.stats.tenants.count("submitted", tenant)
                     self.stats.gauge("queue_depth", len(self._q))
                     self._cv.notify()
                     return req.ticket
             if depth >= self.spec.queue_capacity:
                 why = f"queue full ({self.spec.queue_capacity} requests)"
+            elif tdepth >= quota:
+                why = (f"tenant {tenant} queue quota full "
+                       f"({tdepth}/{quota} of "
+                       f"{self.spec.queue_capacity})")
             else:
                 why = (f"brownout: queue {depth}/"
                        f"{self.spec.queue_capacity} sheds {priority}")
-            return self._shed(why, corr=corr, priority=priority)
+            return self._shed(why, corr=corr, priority=priority,
+                              tenant=tenant)
 
-    def _brownout_admits(self, priority: str, depth: int) -> bool:
+    def _brownout_admits(self, priority: str, depth: int,
+                         tenant: str = "default") -> bool:
         """Class-aware admission under pressure: best_effort is shed
         once the queue is `brownout_be_frac` full, batch at
-        `brownout_batch_frac`; interactive rides to the cap."""
+        `brownout_batch_frac`; interactive rides to the cap.  A tenant
+        with configured brownout overrides uses its own fractions."""
         if priority == "interactive":
             return True
-        frac = (self.spec.brownout_be_frac
-                if priority == "best_effort"
-                else self.spec.brownout_batch_frac)
+        be_frac, batch_frac = self.tenancy.brownout_fracs(
+            tenant, self.spec.brownout_be_frac,
+            self.spec.brownout_batch_frac)
+        frac = be_frac if priority == "best_effort" else batch_frac
         return depth < max(int(frac * self.spec.queue_capacity), 1)
 
     def _shed(self, why: str, corr: Optional[str] = None,
-              priority: str = "interactive") -> "Ticket":
+              priority: str = "interactive",
+              tenant: str = "default") -> "Ticket":
         self.stats.count("shed")
         self.stats.count(f"shed_{priority}")
-        retry = self._class_backoffs.shed_delay(priority)
+        self.stats.tenants.count("shed", tenant)
+        retry = self._class_backoffs.shed_delay(priority,
+                                                tenant=tenant)
         obs.emit_event("serve.shed", why=why, corr=corr,
-                       priority=priority,
+                       priority=priority, tenant=tenant,
                        retry_after=round(retry, 4))
         raise Overloaded(f"request shed ({why}); retry after "
                          f"{retry:.3f}s", retry_after=retry)
@@ -382,6 +412,9 @@ class MicroBatcher:
                           "bucket": [b, p]}
                 ntok = 0
             self.stats.observe_latency(now - r.t_submit)
+            self.stats.tenants.count("completed", r.tenant)
+            self.stats.tenants.observe_latency(now - r.t_submit,
+                                               r.tenant)
             # queue-wait = submit -> this dispatch; service = the
             # batch's device time (shared across its requests)
             self.stats.observe_request(t_disp - r.t_submit,

@@ -100,6 +100,12 @@ class ServeSpec:
     cb_blocks: int = 0        # pool size incl. null block; 0 = auto
     cb_prompt_cap: int = 0    # longest admissible prompt; 0 = widest
                               # bucket prompt_len
+    # model family this engine serves: half of the (family, step)
+    # serving fingerprint.  Engines advertise it on /healthz, the
+    # router dispatches a request's `model` onto matching members
+    # only, and a failover resume must match BOTH halves.  Parsed
+    # lowercase by the str branch of `parse`
+    family: str = "default"
 
     def __post_init__(self):
         norm = []
@@ -140,6 +146,10 @@ class ServeSpec:
             raise ValueError(
                 f"brownout fractions must satisfy 0 < be_frac <= "
                 f"batch_frac <= 1, got be={be} batch={ba}")
+        fam = str(self.family).strip().lower()
+        if not fam:
+            raise ValueError("family must be a non-empty name")
+        object.__setattr__(self, "family", fam)
 
     @property
     def max_prompt_len(self) -> int:
@@ -554,6 +564,7 @@ class InferenceEngine:
         return {"ok": not reasons,
                 "status": "ok" if not reasons else "degraded",
                 "step": self.params_step,
+                "family": self.spec.family,
                 "pinned": self.pinned,
                 "reasons": reasons}
 

@@ -69,6 +69,7 @@ from .engine import InferenceEngine, ServeSpec
 from .router import (LocalEngineHandle, Router, RouterSpec,
                      HttpEngineHandle, _handle_call)
 from .server import InferenceServer
+from .tenancy import TenantRegistry
 
 
 @dataclass(frozen=True)
@@ -120,10 +121,16 @@ class RolloutController:
     transition is counted, logged, and evented."""
 
     def __init__(self, router: Router, workspace: str,
-                 spec: Optional[RolloutSpec] = None, log_fn=print):
+                 spec: Optional[RolloutSpec] = None, log_fn=print,
+                 family: Optional[str] = None):
         self.router = router
         self.spec = spec or RolloutSpec()
         self.log = log_fn
+        # scope this controller to ONE checkpoint family: its canary
+        # lands on a member of that family and promotion touches only
+        # that family's members.  None = whole fleet (the legacy
+        # single-family shape)
+        self.family = family
         self.mgr = CheckpointManager(workspace, log_fn=lambda s: None)
         self.state = "OBSERVE"
         self.pinned_step: int = -1
@@ -208,7 +215,7 @@ class RolloutController:
         self._begin_canary(target)
 
     def _begin_canary(self, target: int) -> None:
-        name = self.router.pick_canary()
+        name = self.router.pick_canary(family=self.family)
         if name is None:
             # no healthy engine to canary on — remember the target and
             # retry next tick rather than wedging
@@ -372,6 +379,10 @@ class RolloutController:
             for other in self.router.names():
                 if other == name:
                     continue
+                if self.family is not None and \
+                        self.router.engine_family(other) != \
+                        self.family:
+                    continue       # another family's member: not ours
                 try:
                     handle = self.router.handle_for(other)
                     got = _handle_call(
@@ -484,9 +495,13 @@ class EngineFleet:
                  workspace: Optional[str] = None,
                  router_spec: Optional[RouterSpec] = None,
                  rollout_spec: Optional[RolloutSpec] = None,
+                 tenancy: Optional[TenantRegistry] = None,
                  log_fn=print):
         self.log = log_fn
-        self.router = Router(handles, spec=router_spec, log_fn=log_fn)
+        self.tenancy = tenancy if tenancy is not None \
+            else TenantRegistry()
+        self.router = Router(handles, spec=router_spec, log_fn=log_fn,
+                             tenancy=self.tenancy)
         self.rollout: Optional[RolloutController] = (
             RolloutController(self.router, workspace,
                               spec=rollout_spec, log_fn=log_fn)
@@ -507,12 +522,16 @@ class EngineFleet:
               workspace: Optional[str] = None, params=None,
               router_spec: Optional[RouterSpec] = None,
               rollout_spec: Optional[RolloutSpec] = None,
+              tenancy: Optional[TenantRegistry] = None,
               warmup_modes=("generate",),
               log_fn=print) -> "EngineFleet":
         """Spawn `size` in-process engine workers (each its own
-        pinned engine, batcher, and stats) over one shared net."""
+        pinned engine, batcher, and stats) over one shared net.  The
+        ONE `tenancy` registry is shared by the router and every
+        worker's admission path, so quotas agree at every hop."""
         if size < 1:
             raise ValueError(f"fleet size must be >= 1, got {size}")
+        tenancy = tenancy if tenancy is not None else TenantRegistry()
         handles = []
         for i in range(size):
             name = f"engine-{i}"
@@ -522,14 +541,17 @@ class EngineFleet:
                 pinned=True)
             srv = InferenceServer(eng, http=False,
                                   warmup_modes=warmup_modes,
+                                  tenancy=tenancy,
                                   log_fn=(lambda s, n=name:
                                           log_fn(f"[{n}] {s}")))
             handles.append(LocalEngineHandle(name, srv))
         fleet = cls(handles, workspace=workspace,
                     router_spec=router_spec,
-                    rollout_spec=rollout_spec, log_fn=log_fn)
+                    rollout_spec=rollout_spec, tenancy=tenancy,
+                    log_fn=log_fn)
         fleet._spawn_cfg = dict(net=net, spec=spec,
                                 workspace=workspace, params=params,
+                                tenancy=tenancy,
                                 warmup_modes=tuple(warmup_modes))
         fleet._next_idx = size
         return fleet
@@ -538,13 +560,14 @@ class EngineFleet:
     def adopt(cls, urls: List[str], workspace: Optional[str] = None,
               router_spec: Optional[RouterSpec] = None,
               rollout_spec: Optional[RolloutSpec] = None,
+              tenancy: Optional[TenantRegistry] = None,
               log_fn=print) -> "EngineFleet":
         """Adopt already-running engine processes by base URL."""
         handles = [HttpEngineHandle(f"engine-{i}", u)
                    for i, u in enumerate(urls)]
         return cls(handles, workspace=workspace,
                    router_spec=router_spec, rollout_spec=rollout_spec,
-                   log_fn=log_fn)
+                   tenancy=tenancy, log_fn=log_fn)
 
     @classmethod
     def from_hostfile(cls, path: str, default_port: int = 8000,
@@ -614,6 +637,7 @@ class EngineFleet:
             pinned=True)
         srv = InferenceServer(eng, http=False,
                               warmup_modes=cfg["warmup_modes"],
+                              tenancy=cfg.get("tenancy"),
                               log_fn=(lambda s, n=name:
                                       self.log(f"[{n}] {s}")))
         h = LocalEngineHandle(name, srv)
@@ -649,12 +673,15 @@ class EngineFleet:
 
     # -- client API ---------------------------------------------------------
     def generate(self, tokens, timeout=None, deadline=None,
-                 priority="interactive") -> Dict[str, Any]:
+                 priority="interactive", tenant=None,
+                 model=None) -> Dict[str, Any]:
         return self.router.route("generate", tokens, timeout=timeout,
-                                 deadline=deadline, priority=priority)
+                                 deadline=deadline, priority=priority,
+                                 tenant=tenant, model=model)
 
     def generate_stream(self, tokens, timeout=None, max_new=None,
-                        deadline=None, priority="interactive"):
+                        deadline=None, priority="interactive",
+                        tenant=None, model=None):
         """Streaming generate through the fleet (cb members only):
         yields {"token": t} events then the {"done": True, ...}
         summary; retries on another engine only before the first
@@ -662,12 +689,15 @@ class EngineFleet:
         return self.router.route_stream(tokens, timeout=timeout,
                                         max_new=max_new,
                                         deadline=deadline,
-                                        priority=priority)
+                                        priority=priority,
+                                        tenant=tenant, model=model)
 
     def predict(self, tokens, timeout=None, deadline=None,
-                priority="interactive") -> Dict[str, Any]:
+                priority="interactive", tenant=None,
+                model=None) -> Dict[str, Any]:
         return self.router.route("predict", tokens, timeout=timeout,
-                                 deadline=deadline, priority=priority)
+                                 deadline=deadline, priority=priority,
+                                 tenant=tenant, model=model)
 
     def snapshot(self) -> Dict[str, Any]:
         out = self.router.snapshot()
@@ -715,6 +745,7 @@ class FleetServer:
         from . import qos as _qos
         from .batcher import DeadlineExpired as _DE
         from .batcher import Overloaded as _OL
+        from .router import UnknownModel as _UM
 
         fleet, metrics = self.fleet, self.metrics
 
@@ -790,11 +821,17 @@ class FleetServer:
                 line."""
                 mn = req.get("max_new")
                 link = self._remote_trace()
+                # degrade-never-reject: garbled tenant folds to
+                # "default" (qos.check_tenant cannot raise)
+                tenant = _qos.check_tenant(
+                    req.get("tenant")
+                    or self.headers.get(_qos.TENANT_HEADER))
                 # the span covers ADMISSION only (route_stream admits
                 # eagerly and returns the generator) — the router's
                 # stream spans anchor to it via the thread-local; a
                 # span must never stay open across generator yields
                 with obs.span("fleet.request", mode="stream",
+                              tenant=tenant,
                               trace=link[0] if link else None,
                               parent=((link[1] or None)
                                       if link else None)):
@@ -805,7 +842,8 @@ class FleetServer:
                             self.headers.get(_qos.DEADLINE_HEADER)),
                         priority=_qos.check_priority(
                             req.get("priority")
-                            or self.headers.get(_qos.PRIORITY_HEADER)))
+                            or self.headers.get(_qos.PRIORITY_HEADER)),
+                        tenant=tenant, model=req.get("model"))
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "application/x-ndjson")
@@ -835,7 +873,11 @@ class FleetServer:
                         self._stream(tokens, req)
                         return
                     link = self._remote_trace()
+                    tenant = _qos.check_tenant(
+                        req.get("tenant")
+                        or self.headers.get(_qos.TENANT_HEADER))
                     with obs.span("fleet.request", mode=mode,
+                                  tenant=tenant,
                                   trace=link[0] if link else None,
                                   parent=((link[1] or None)
                                           if link else None)):
@@ -848,8 +890,13 @@ class FleetServer:
                             priority=_qos.check_priority(
                                 req.get("priority")
                                 or self.headers.get(
-                                    _qos.PRIORITY_HEADER)))
+                                    _qos.PRIORITY_HEADER)),
+                            tenant=tenant, model=req.get("model"))
                     self._reply(200, out)
+                except _UM as e:
+                    # honest fast 404: the fleet does not serve this
+                    # model family — never a shed, never a strike
+                    self._reply(404, {"error": str(e)})
                 except _OL as e:
                     self._reply(503, {"error": str(e),
                                       "retry_after": e.retry_after},

@@ -46,6 +46,12 @@ PRIORITIES = ("interactive", "batch", "best_effort")
 DEADLINE_HEADER = "X-Deadline-Ms"
 PRIORITY_HEADER = "X-Priority"
 
+#: Tenant id header (serve/tenancy.py).  Degrade-never-reject: a
+#: missing/blank/oversized/garbled value falls back to the `default`
+#: tenant — tenancy is an isolation boundary, not an auth gate, and a
+#: bad tenant header must never 400 a request
+TENANT_HEADER = "X-Tenant"
+
 #: W3C-traceparent-style trace context pair: the trace id is minted
 #: once at the request's root span and carried VERBATIM on every hop
 #: (frontend → router → worker, hedge legs, failover resumes,
@@ -70,6 +76,22 @@ def check_priority(priority: Optional[str]) -> str:
         raise ValueError(f"unknown priority {priority!r}; classes are "
                          f"{PRIORITIES}")
     return p
+
+
+def check_tenant(tenant: Optional[str]) -> str:
+    """Normalize a tenant id (None/blank = the `default` tenant).
+    NEVER raises: an unparseable or hostile tenant id degrades to a
+    sanitized string — quota lookup folds unknown ids into the shared
+    `other` envelope, so garbage in the header costs the sender, not
+    the request.  Ids are trimmed, lowercased, and truncated to 64
+    chars; characters outside [a-z0-9_-] become `_`."""
+    if tenant is None:
+        return "default"
+    t = str(tenant).strip().lower()[:64]
+    if not t:
+        return "default"
+    return "".join(c if (c.isalnum() and c.isascii()) or c in "_-"
+                   else "_" for c in t)
 
 
 def resolve_deadline(timeout: Optional[float],
@@ -195,34 +217,79 @@ class RetryBudget:
 
 
 class ClassBackoffs:
-    """Per-priority-class shed Retry-After: each class escalates over
-    ITS consecutive sheds and resets on ITS next successful admission,
-    with lower classes starting (and capping) `_CLASS_FACTORS` higher.
-    The interactive stream reproduces the single-class Backoff the
-    admission paths used before priorities existed."""
+    """Per-(tenant, priority-class) shed Retry-After: each stream
+    escalates over ITS consecutive sheds and resets on ITS next
+    successful admission, with lower classes starting (and capping)
+    `_CLASS_FACTORS` higher.  Streaks are scoped per TENANT as well as
+    per class: before tenancy, any successful dispatch reset the
+    escalation streak for everyone, so a busy tenant's completions
+    masked another tenant's congestion and its Retry-After never
+    escalated.  The `default` tenant's interactive stream reproduces
+    the single-class Backoff the admission paths used before
+    priorities existed.
+
+    Distinct tenant keys are bounded (`max_tenants`): callers normally
+    pass registry-folded labels, but a raw-id caller cannot grow this
+    dict without bound either — overflow tenants share the `other`
+    stream."""
 
     def __init__(self, base: float = 0.05, cap: float = 2.0,
-                 seed: int = 0):
+                 seed: int = 0, max_tenants: int = 64):
         self._lock = threading.Lock()
+        self._base, self._cap, self._seed = base, cap, seed
+        self.max_tenants = int(max_tenants)
         self._backoffs = {}
         self._streaks = {}
-        for i, (pri, factor) in enumerate(_CLASS_FACTORS):
-            self._backoffs[pri] = faults.Backoff(
-                base=base * factor, cap=cap * factor, seed=seed + i)
-            self._streaks[pri] = 0
+        self._tenants = set()
+        for pri, _ in _CLASS_FACTORS:
+            self._ensure("default", pri)
 
-    def shed_delay(self, priority: str) -> float:
-        """Record one shed of `priority`; the Retry-After to hint."""
-        with self._lock:
-            self._streaks[priority] += 1
-            attempt = self._streaks[priority]
-        return self._backoffs[priority].delay(attempt - 1)
+    def _factor(self, priority: str) -> float:
+        for pri, factor in _CLASS_FACTORS:
+            if pri == priority:
+                return factor
+        return 1.0
 
-    def reset(self, priority: str) -> None:
-        """A successful admission of `priority` ends its streak."""
-        with self._lock:
-            self._streaks[priority] = 0
+    def _key(self, tenant: str, priority: str):
+        """Fold an unseen tenant into `other` once the bound is hit
+        (lock held by caller)."""
+        if tenant not in self._tenants:
+            if len(self._tenants) >= self.max_tenants:
+                tenant = "other"
+            self._tenants.add(tenant)
+        return (tenant, priority)
 
-    def streak(self, priority: str) -> int:
+    def _ensure(self, tenant: str, priority: str):
+        key = self._key(tenant, priority)
+        if key not in self._backoffs:
+            i = len(self._backoffs)
+            f = self._factor(priority)
+            self._backoffs[key] = faults.Backoff(
+                base=self._base * f, cap=self._cap * f,
+                seed=self._seed + i)
+            self._streaks[key] = 0
+        return key
+
+    def shed_delay(self, priority: str,
+                   tenant: str = "default") -> float:
+        """Record one shed of (tenant, priority); the Retry-After to
+        hint."""
         with self._lock:
-            return self._streaks[priority]
+            key = self._ensure(tenant, priority)
+            self._streaks[key] += 1
+            attempt = self._streaks[key]
+            backoff = self._backoffs[key]
+        return backoff.delay(attempt - 1)
+
+    def reset(self, priority: str, tenant: str = "default") -> None:
+        """A successful admission of (tenant, priority) ends its
+        streak — and ONLY its streak: another tenant's congestion
+        keeps escalating."""
+        with self._lock:
+            key = self._ensure(tenant, priority)
+            self._streaks[key] = 0
+
+    def streak(self, priority: str, tenant: str = "default") -> int:
+        with self._lock:
+            key = self._ensure(tenant, priority)
+            return self._streaks[key]

@@ -64,12 +64,23 @@ from ..utils import faults
 from . import qos
 from .batcher import Cancelled, DeadlineExpired, Overloaded
 from .session import SessionManager
+from .tenancy import TenantCounts, TenantRegistry
 
 
 class EngineUnavailable(RuntimeError):
     """The chosen engine could not take the request at all (process
     dead, connection refused, handler crashed) — retried on another
     engine and charged to this one as a strike."""
+
+
+class UnknownModel(ValueError):
+    """The requested model family is served by NO member of the fleet:
+    an honest fast rejection (the HTTP layer's 404) decided at
+    admission, before any engine is picked — never a strike against an
+    engine, never a shed, never a Retry-After (waiting will not make
+    the family appear).  A ValueError subclass so duck-typed callers
+    that predate model-aware routing still treat it as an unservable
+    request, not an engine failure."""
 
 
 class _FailoverStale(RuntimeError):
@@ -227,15 +238,16 @@ class LocalEngineHandle:
                 timeout: Optional[float] = None,
                 deadline: Optional[float] = None,
                 priority: str = "interactive",
-                cancel_event: Optional[threading.Event] = None
-                ) -> Dict[str, Any]:
+                cancel_event: Optional[threading.Event] = None,
+                tenant: str = "default") -> Dict[str, Any]:
         if not self._alive:
             raise EngineUnavailable(f"engine {self.name} is down")
         call = (self.server.generate if mode == "generate"
                 else self.server.predict)
         try:
             return call(tokens, timeout=timeout, deadline=deadline,
-                        priority=priority, cancel_event=cancel_event)
+                        priority=priority, cancel_event=cancel_event,
+                        tenant=tenant)
         except (Overloaded, DeadlineExpired, TimeoutError, ValueError,
                 Cancelled):
             raise
@@ -248,7 +260,8 @@ class LocalEngineHandle:
                        deadline: Optional[float] = None,
                        priority: str = "interactive",
                        cancel_event: Optional[threading.Event] = None,
-                       resume_from: int = 0):
+                       resume_from: int = 0,
+                       tenant: str = "default"):
         """Streaming generate (cb engines only).  Admission happens
         HERE, before any event is yielded — the router's commit point
         for retry-on-other-engine.  Returns an iterator of ndjson-
@@ -261,7 +274,8 @@ class LocalEngineHandle:
             ticket = self.server.generate_stream(
                 tokens, timeout=timeout, max_new=max_new,
                 deadline=deadline, priority=priority,
-                cancel_event=cancel_event, resume_from=resume_from)
+                cancel_event=cancel_event, resume_from=resume_from,
+                tenant=tenant)
         except (Overloaded, DeadlineExpired, TimeoutError, ValueError,
                 Cancelled):
             raise
@@ -355,17 +369,21 @@ class HttpEngineHandle:
     @staticmethod
     def _qos_headers(deadline: Optional[float],
                      priority: Optional[str],
-                     trace=None) -> Dict[str, str]:
+                     trace=None,
+                     tenant: Optional[str] = None) -> Dict[str, str]:
         """End-to-end propagation over the wire: remaining-ms deadline
-        header (re-anchored by the receiver), priority class, and the
-        `X-Trace-Id`/`X-Parent-Span` pair — the worker's spans anchor
-        under the router's attempt span in the merged trace."""
+        header (re-anchored by the receiver), priority class, tenant
+        id (`X-Tenant`), and the `X-Trace-Id`/`X-Parent-Span` pair —
+        the worker's spans anchor under the router's attempt span in
+        the merged trace."""
         hdrs: Dict[str, str] = {}
         dl = qos.deadline_to_header(deadline)
         if dl is not None:
             hdrs[qos.DEADLINE_HEADER] = dl
         if priority is not None:
             hdrs[qos.PRIORITY_HEADER] = str(priority)
+        if tenant is not None:
+            hdrs[qos.TENANT_HEADER] = str(tenant)
         hdrs.update(qos.trace_to_headers(trace))
         return hdrs
 
@@ -373,7 +391,8 @@ class HttpEngineHandle:
                 timeout: Optional[float] = None,
                 deadline: Optional[float] = None,
                 priority: Optional[str] = None,
-                trace=None) -> Dict[str, Any]:
+                trace=None,
+                tenant: Optional[str] = None) -> Dict[str, Any]:
         toks = (tokens.tolist() if isinstance(tokens, np.ndarray)
                 else list(tokens))
         payload = {"tokens": [int(t) for t in toks]}
@@ -383,13 +402,14 @@ class HttpEngineHandle:
                                       self.connect_timeout_s)
         return self._call("POST", f"/{mode}", payload, timeout=budget,
                           headers=self._qos_headers(deadline, priority,
-                                                    trace))
+                                                    trace, tenant))
 
     def request_stream(self, tokens, timeout: Optional[float] = None,
                        max_new: Optional[int] = None,
                        deadline: Optional[float] = None,
                        priority: Optional[str] = None,
-                       resume_from: int = 0, trace=None):
+                       resume_from: int = 0, trace=None,
+                       tenant: Optional[str] = None):
         """Streaming generate over HTTP: POST {"stream": true} and
         decode the chunked ndjson line-by-line WITHOUT buffering the
         body.  The response status is the commit point: admission
@@ -411,7 +431,8 @@ class HttpEngineHandle:
         budget = qos.transport_budget(deadline, timeout,
                                       self.connect_timeout_s)
         hdrs = {"Content-Type": "application/json"}
-        hdrs.update(self._qos_headers(deadline, priority, trace))
+        hdrs.update(self._qos_headers(deadline, priority, trace,
+                                      tenant))
         req = urllib.request.Request(
             f"{self.base_url}/generate",
             data=json.dumps(payload).encode(), method="POST",
@@ -472,6 +493,9 @@ class _Member:
     handle: Any
     healthy: bool = True          # last probe verdict (soft: re-enters
     step: int = -1                # on the next ok probe)
+    family: str = "default"       # checkpoint family advertised on
+                                  # /healthz: the fingerprint namespace
+                                  # is (family, step)
     queue_depth: int = 0
     in_flight: int = 0
     strikes: int = 0              # consecutive probe/dispatch failures
@@ -497,7 +521,8 @@ class RouterStats:
               "quarantines", "readmissions", "joins", "retires",
               "attempts", "hedges", "hedge_wins", "deadline_terminal",
               "expired_on_arrival", "budget_denied", "brownout_sheds",
-              "shed_interactive", "shed_batch", "shed_best_effort")
+              "shed_interactive", "shed_batch", "shed_best_effort",
+              "unknown_model")
 
     #: per-request lifecycle stages the router can time (the stage
     #: taxonomy in docs/OBSERVABILITY.md); each gets its own
@@ -511,11 +536,14 @@ class RouterStats:
             setattr(self, f, 0)
         self._latencies: List[float] = []
         self._t0 = time.monotonic()
-        self._routed_t: deque = deque(maxlen=16384)   # arrival stamps
+        self._routed_t: deque = deque(maxlen=16384)   # (stamp, tenant)
         self._shed_t: deque = deque(maxlen=16384)     # (stamp, priority,
-                                                      #  brownout)
+                                                      #  brownout, tenant)
         self._done_t: deque = deque(maxlen=16384)     # (stamp, latency,
-                                                      #  priority)
+                                                      #  priority, tenant)
+        # per-tenant lifetime accounting (bounded label set; callers
+        # pass registry-FOLDED labels) — exported as singa_tenant_*
+        self.tenants = TenantCounts(("routed", "completed", "shed"))
         # owned histogram handles, attached by register_into (None
         # without a registry — observe_latency/observe_stage stay
         # cheap no-ops on the histogram half)
@@ -528,18 +556,29 @@ class RouterStats:
         with self._lock:
             setattr(self, fieldname, getattr(self, fieldname) + n)
             if fieldname == "routed":
-                self._routed_t.extend([now] * n)
+                self._routed_t.extend([(now, "default")] * n)
             elif fieldname == "shed":
                 self._shed_t.extend(
-                    [(now, "interactive", False)] * n)
+                    [(now, "interactive", False, "default")] * n)
+
+    def observe_routed(self, tenant: str = "default",
+                       n: int = 1) -> None:
+        """One admitted request, attributed to its tenant (the
+        tenant-aware twin of `count("routed")`)."""
+        now = time.monotonic()
+        with self._lock:
+            self.routed += n
+            self._routed_t.extend([(now, tenant)] * n)
+        self.tenants.count("routed", tenant, n)
 
     def observe_shed(self, priority: str = "interactive",
-                     brownout: bool = False, n: int = 1) -> None:
-        """One shed, attributed to its class.  `brownout=False` is a
-        CAPACITY shed (nothing could take the request) — the pressure
-        signal that engages brownout; brownout sheds themselves are
-        excluded from it, or shedding would keep brownout engaged
-        forever (positive feedback)."""
+                     brownout: bool = False, n: int = 1,
+                     tenant: str = "default") -> None:
+        """One shed, attributed to its class and tenant.
+        `brownout=False` is a CAPACITY shed (nothing could take the
+        request) — the pressure signal that engages brownout; brownout
+        sheds themselves are excluded from it, or shedding would keep
+        brownout engaged forever (positive feedback)."""
         now = time.monotonic()
         with self._lock:
             self.shed += n
@@ -547,15 +586,20 @@ class RouterStats:
                     getattr(self, f"shed_{priority}") + n)
             if brownout:
                 self.brownout_sheds += n
-            self._shed_t.extend([(now, priority, brownout)] * n)
+            self._shed_t.extend([(now, priority, brownout, tenant)] * n)
+        self.tenants.count("shed", tenant, n)
 
     def observe_latency(self, seconds: float,
-                        priority: str = "interactive") -> None:
+                        priority: str = "interactive",
+                        tenant: str = "default") -> None:
         with self._lock:
             self._latencies.append(seconds)
             if len(self._latencies) > 4096:
                 del self._latencies[:2048]
-            self._done_t.append((time.monotonic(), seconds, priority))
+            self._done_t.append((time.monotonic(), seconds, priority,
+                                 tenant))
+        self.tenants.count("completed", tenant)
+        self.tenants.observe_latency(seconds, tenant)
         h = self._hist_latency
         if h is not None:
             h.observe(float(seconds))
@@ -588,12 +632,15 @@ class RouterStats:
                            else self.window_s)
             window = min(window, max(now - self._t0, 1e-6))
             cut = now - window
-            routed = sum(1 for t in self._routed_t if t >= cut)
-            sheds = [(p, b) for t, p, b in self._shed_t if t >= cut]
-            done = [(l, p) for t, l, p in self._done_t if t >= cut]
-        lats = sorted(l for l, _ in done)
+            routed_rows = [tn for t, tn in self._routed_t if t >= cut]
+            sheds = [(p, b, tn) for t, p, b, tn in self._shed_t
+                     if t >= cut]
+            done = [(l, p, tn) for t, l, p, tn in self._done_t
+                    if t >= cut]
+        routed = len(routed_rows)
+        lats = sorted(l for l, _, _ in done)
         shed = len(sheds)
-        capacity_shed = sum(1 for _, b in sheds if not b)
+        capacity_shed = sum(1 for _, b, _ in sheds if not b)
 
         def q(frac, xs=None):
             xs = lats if xs is None else xs
@@ -602,14 +649,39 @@ class RouterStats:
             return round(
                 xs[min(int(frac * len(xs)), len(xs) - 1)] * 1e3, 3)
         shed_by_class = {p: 0 for p in qos.PRIORITIES}
-        for p, _ in sheds:
+        for p, _, _ in sheds:
             shed_by_class[p] = shed_by_class.get(p, 0) + 1
         completed_by_class = {p: 0 for p in qos.PRIORITIES}
         p95_by_class: Dict[str, Optional[float]] = {}
         for pri in qos.PRIORITIES:
-            cls = sorted(l for l, p in done if p == pri)
+            cls = sorted(l for l, p, _ in done if p == pri)
             completed_by_class[pri] = len(cls)
             p95_by_class[pri] = q(0.95, cls)
+        # per-tenant window views: the autoscaler's quota-weighted
+        # shed signal and the router's per-tenant brownout pressure
+        tenant_labels = sorted(
+            set(routed_rows)
+            | {tn for _, _, tn in sheds}
+            | {tn for _, _, tn in done})
+        routed_by_tenant = {tn: 0 for tn in tenant_labels}
+        for tn in routed_rows:
+            routed_by_tenant[tn] += 1
+        shed_by_tenant = {tn: 0 for tn in tenant_labels}
+        capacity_shed_by_tenant = {tn: 0 for tn in tenant_labels}
+        for _, b, tn in sheds:
+            shed_by_tenant[tn] += 1
+            if not b:
+                capacity_shed_by_tenant[tn] += 1
+        completed_by_tenant = {tn: 0 for tn in tenant_labels}
+        p95_by_tenant: Dict[str, Optional[float]] = {}
+        for tn in tenant_labels:
+            tls = sorted(l for l, _, t2 in done if t2 == tn)
+            completed_by_tenant[tn] = len(tls)
+            p95_by_tenant[tn] = q(0.95, tls)
+        capacity_shed_rate_by_tenant = {
+            tn: round(capacity_shed_by_tenant[tn]
+                      / max(routed_by_tenant.get(tn, 0), 1), 4)
+            for tn in tenant_labels}
         return {
             "window_s": round(window, 3),
             "routed": routed,
@@ -625,6 +697,12 @@ class RouterStats:
             "shed_by_class": shed_by_class,
             "completed_by_class": completed_by_class,
             "p95_by_class": p95_by_class,
+            "routed_by_tenant": routed_by_tenant,
+            "shed_by_tenant": shed_by_tenant,
+            "completed_by_tenant": completed_by_tenant,
+            "p95_by_tenant": p95_by_tenant,
+            "capacity_shed_rate_by_tenant":
+                capacity_shed_rate_by_tenant,
         }
 
     def latency_quantile(self, q: float) -> Optional[float]:
@@ -651,6 +729,7 @@ class RouterStats:
         out["shed_rate_recent"] = win["shed_rate"]
         out["p95_latency_recent_ms"] = win["p95_latency_ms"]
         out["p99_latency_recent_ms"] = win["p99_latency_ms"]
+        out["by_tenant"] = self.tenants.snapshot()
         return out
 
     def register_into(self, registry,
@@ -681,6 +760,7 @@ class RouterStats:
             return out
 
         registry.register_collector(collect)
+        self.tenants.register_into(registry)
 
 
 class RequestLog:
@@ -720,7 +800,8 @@ class Router:
     controller reads `members()` / calls `handle_for`."""
 
     def __init__(self, handles: List[Any],
-                 spec: Optional[RouterSpec] = None, log_fn=print):
+                 spec: Optional[RouterSpec] = None, log_fn=print,
+                 tenancy: Optional[TenantRegistry] = None):
         if not handles:
             raise ValueError("Router needs at least one engine handle")
         names = [h.name for h in handles]
@@ -735,14 +816,20 @@ class Router:
         self._backoff = faults.Backoff(base=self.spec.readmit_base_s,
                                        cap=self.spec.readmit_cap_s,
                                        seed=self.spec.seed)
-        # per-class shed Retry-After (the old single-class backoff is
-        # the interactive stream)
+        # per-(tenant, class) shed Retry-After (the old single-class
+        # backoff is the default tenant's interactive stream)
         self._shed_backoffs = qos.ClassBackoffs(base=0.05, cap=2.0,
                                                 seed=self.spec.seed + 1)
         # global retry budget: retries AND hedges draw from it
         self.retry_budget = qos.RetryBudget(
             ratio=self.spec.retry_budget_ratio,
             burst=self.spec.retry_budget_burst)
+        # per-tenant QoS envelopes: every retry/hedge/resume charges
+        # the REQUESTING tenant's child budget (floor first, then the
+        # shared bucket) — an unconfigured registry is all-default,
+        # which degenerates to the pre-tenancy global arithmetic
+        self.tenancy = tenancy or TenantRegistry()
+        self.tenancy.bind_budgets(self.retry_budget)
         # durable stream sessions: the journal mid-stream failover
         # resumes from (serve/session.py)
         self.sessions = SessionManager()
@@ -756,6 +843,7 @@ class Router:
         self._hedge_cache: float = float(self.spec.hedge_max_s)
         self._hedge_cache_t: float = 0.0
         self._pressure: float = 0.0
+        self._pressure_by_tenant: Dict[str, float] = {}
         self._pressure_t: float = 0.0
         self._probe_stop = threading.Event()
         self._probe_thread: Optional[threading.Thread] = None
@@ -789,7 +877,8 @@ class Router:
             return [{
                 "name": n, "healthy": m.healthy,
                 "quarantined": m.quarantined, "strikes": m.strikes,
-                "step": m.step, "in_flight": m.in_flight,
+                "step": m.step, "family": m.family,
+                "in_flight": m.in_flight,
                 "queue_depth": m.queue_depth,
                 "dispatched": m.dispatched, "failed": m.failed,
                 "quarantines": m.quarantines, "draining": m.draining,
@@ -805,6 +894,18 @@ class Router:
         with self._lock:
             m = self._members.get(name)
             return m.step if m is not None else -1
+
+    def engine_family(self, name: str) -> str:
+        with self._lock:
+            m = self._members.get(name)
+            return m.family if m is not None else "default"
+
+    def families(self) -> List[str]:
+        """Every checkpoint family any member advertises (including
+        unhealthy ones: a family mid-quarantine is still SERVED — a
+        request for it sheds honestly rather than 404ing)."""
+        with self._lock:
+            return sorted({m.family for m in self._members.values()})
 
     # -- runtime membership (autoscaler surface) ----------------------------
     def add_engine(self, handle) -> None:
@@ -898,6 +999,7 @@ class Router:
             m.last_health = h
             m.healthy = bool(h.get("ok"))
             m.step = int(h.get("step", -1))
+            m.family = str(h.get("family", "default"))
             m.queue_depth = int(h.get("queue_depth", 0))
             if m.healthy:
                 m.strikes = 0
@@ -941,14 +1043,19 @@ class Router:
                        bench_s=round(delay, 4))
 
     # -- dispatch -----------------------------------------------------------
-    def _pick(self, exclude: set) -> Optional[str]:
+    def _pick(self, exclude: set,
+              family: Optional[str] = None) -> Optional[str]:
         """Least-loaded healthy engine (in-flight + probed queue
-        depth), excluding already-tried ones."""
+        depth), excluding already-tried ones; `family` restricts to
+        members advertising that checkpoint family (model-aware
+        dispatch — None routes anywhere, the legacy single-family
+        shape)."""
         with self._lock:
             cands = [(m.in_flight + m.queue_depth, n)
                      for n, m in self._members.items()
                      if n not in exclude and m.healthy
-                     and not m.quarantined and not m.draining]
+                     and not m.quarantined and not m.draining
+                     and (family is None or m.family == family)]
             if not cands:
                 return None
             _, name = min(cands)
@@ -960,6 +1067,29 @@ class Router:
             m = self._members.get(name)
             if m is not None:
                 m.in_flight -= 1
+
+    def _check_family(self, model: Optional[str]) -> Optional[str]:
+        """Normalize the requested model family against what the fleet
+        SERVES (any member, healthy or not: a family mid-quarantine
+        sheds honestly later rather than 404ing).  None/blank routes
+        anywhere — the legacy single-family shape.  An unserved family
+        raises `UnknownModel` before any engine is picked: a fast 404,
+        never a strike, never a Retry-After."""
+        if model is None:
+            return None
+        family = str(model).strip().lower()
+        if not family:
+            return None
+        with self._lock:
+            served = {m.family for m in self._members.values()}
+        if family not in served:
+            self.stats.count("unknown_model")
+            obs.emit_event("serve.unknown_model", family=family,
+                           served=sorted(served))
+            raise UnknownModel(
+                f"no engine serves model family {family!r} "
+                f"(served: {sorted(served)})")
+        return family
 
     # -- hedging / brownout control signals ---------------------------------
     def _hedge_delay(self) -> float:
@@ -978,12 +1108,16 @@ class Router:
         self._hedge_cache, self._hedge_cache_t = d, now
         return d
 
-    def _brownout_sheds(self, priority: str) -> bool:
+    def _brownout_sheds(self, priority: str,
+                        tenant: str = "default") -> bool:
         """Router-level brownout: when the recent CAPACITY-shed rate
         (sheds where nothing could take the request — brownout's own
         sheds excluded, see RouterStats.observe_shed) crosses
         `brownout_shed_rate`, stop admitting best_effort; at 3x the
-        threshold, batch too.  Interactive always passes."""
+        threshold, batch too.  Interactive always passes.  The
+        pressure is the TENANT'S OWN capacity-shed rate: one tenant's
+        overflow browning out its own background classes is the system
+        working — it must never brown out a quiet neighbor's."""
         if priority == "interactive" or \
                 float(self.spec.brownout_shed_rate) <= 0:
             return False
@@ -991,18 +1125,22 @@ class Router:
         if now - self._pressure_t > 0.5:
             win = self.stats.windowed(5.0)
             self._pressure = float(win["capacity_shed_rate"])
+            self._pressure_by_tenant = dict(
+                win.get("capacity_shed_rate_by_tenant") or {})
             self._pressure_t = now
+        pressure = float(self._pressure_by_tenant.get(tenant, 0.0))
         thr = float(self.spec.brownout_shed_rate)
         if priority == "best_effort":
-            return self._pressure >= thr
-        return self._pressure >= 3 * thr
+            return pressure >= thr
+        return pressure >= 3 * thr
 
     def _call_handle(self, name: str, mode: str, tokens,
                      timeout, deadline, priority,
-                     cancel_event, trace=None) -> Dict[str, Any]:
+                     cancel_event, trace=None,
+                     tenant: str = "default") -> Dict[str, Any]:
         """One engine call, forwarding only the QoS keywords the
         handle's `request` signature accepts (duck-typed handles
-        predate deadlines/priorities/trace context)."""
+        predate deadlines/priorities/trace context/tenancy)."""
         with self._lock:
             m = self._members.get(name)
         if m is None:
@@ -1012,30 +1150,33 @@ class Router:
             m.handle.request, (mode, tokens),
             {"timeout": timeout, "deadline": deadline,
              "priority": priority, "cancel_event": cancel_event,
-             "trace": trace})
+             "trace": trace, "tenant": tenant})
 
     def _try_hedge(self, exclude: set, cancels: Dict[str, Any],
-                   launch, deadline) -> Optional[str]:
+                   launch, deadline, tenant: str = "default",
+                   family: Optional[str] = None) -> Optional[str]:
         """Launch the hedged attempt if the budget, the fleet, and the
         deadline allow.  A `serve.hedge` fault abandons the hedge only
         — the primary is untouched.  Returns the hedge engine's name,
         or None (with the spent token refunded when no dispatch
-        happened)."""
+        happened).  The hedge charges the REQUESTING tenant's budget
+        and stays inside the request's checkpoint family."""
         rem = qos.remaining_s(deadline)
         if rem is not None and rem <= 0:
             return None               # a hedge would be dead on arrival
-        if not self.retry_budget.spend():
+        budget = self.tenancy.budget(tenant)
+        if not budget.spend():
             self.stats.count("budget_denied")
             return None               # degrade to single-shot, not shed
-        name = self._pick(exclude)
+        name = self._pick(exclude, family=family)
         if name is None:
-            self.retry_budget.refund()
+            budget.refund()
             return None
         try:
             faults.maybe_fault("serve.hedge")
         except faults.FaultError as e:
             self._release(name)
-            self.retry_budget.refund()
+            budget.refund()
             obs.emit_event("serve.hedge_abandoned", engine=name,
                            why=str(e))
             return None
@@ -1047,7 +1188,9 @@ class Router:
     def _hedged_request(self, name: str, mode: str, tokens,
                         timeout, deadline, priority,
                         corr: Optional[str] = None, link=None,
-                        info: Optional[dict] = None) -> tuple:
+                        info: Optional[dict] = None,
+                        tenant: str = "default",
+                        family: Optional[str] = None) -> tuple:
         """Dispatch to `name`, hedging onto a sibling once the
         p95-derived delay elapses without a result; first result wins
         and the loser is cancelled.  Owns releasing every in-flight
@@ -1078,7 +1221,8 @@ class Router:
                         priority=priority,
                         cancel_event=cancels[engine_name],
                         trace=((asp.trace, asp.span_id)
-                               if asp.trace else None))
+                               if asp.trace else None),
+                        tenant=tenant)
                 resq.put((engine_name, "ok", out))
             except (Overloaded, DeadlineExpired, TimeoutError,
                     ValueError, Cancelled) as e:
@@ -1119,7 +1263,8 @@ class Router:
             except queue.Empty:
                 tried_hedge = True
                 hedge_name = self._try_hedge(
-                    set(cancels), cancels, launch, deadline)
+                    set(cancels), cancels, launch, deadline,
+                    tenant=tenant, family=family)
                 if hedge_name is not None:
                     pending.add(hedge_name)
                     if info is not None:
@@ -1149,14 +1294,21 @@ class Router:
     def route(self, mode: str, tokens,
               timeout: Optional[float] = None,
               deadline: Optional[float] = None,
-              priority: str = "interactive") -> Dict[str, Any]:
+              priority: str = "interactive",
+              tenant: Optional[str] = None,
+              model: Optional[str] = None) -> Dict[str, Any]:
         """Dispatch one request; retries engine failures on other
-        engines (every retry and hedge drawing from the global
-        `retry_budget`, and never outliving `deadline`) and sheds
-        (`Overloaded` + per-class Retry-After) only when no engine can
-        take it.  The result carries `engine`, the member that served
-        it."""
+        engines (every retry and hedge charging the REQUESTING
+        tenant's view of the retry budget, and never outliving
+        `deadline`) and sheds (`Overloaded` + per-(tenant, class)
+        Retry-After) only when no engine can take it.  `model`
+        restricts dispatch to engines advertising that checkpoint
+        family — an unserved family raises `UnknownModel` (the honest
+        fast 404) before any engine is picked.  The result carries
+        `engine`, the member that served it."""
         priority = qos.check_priority(priority)
+        tenant = self.tenancy.label(tenant)
+        family = self._check_family(model)
         if timeout is None:
             timeout = self.spec.request_timeout_s
         deadline = qos.resolve_deadline(timeout, deadline,
@@ -1169,11 +1321,13 @@ class Router:
             raise DeadlineExpired(
                 f"dead on arrival at router: deadline passed "
                 f"{-rem:.3f}s ago")
-        if self._brownout_sheds(priority):
+        if self._brownout_sheds(priority, tenant):
             self._shed(f"brownout sheds {priority}",
-                       priority=priority, brownout=True)
-        self.stats.count("routed")
-        self.retry_budget.earn()      # the primary dispatch's earning
+                       priority=priority, brownout=True,
+                       tenant=tenant)
+        self.stats.observe_routed(tenant)
+        tbudget = self.tenancy.budget(tenant)
+        tbudget.earn()                # the primary dispatch's earning
         budget = (self.spec.max_attempts
                   if self.spec.max_attempts > 0 else len(self._members))
         tried: set = set()
@@ -1187,7 +1341,7 @@ class Router:
         corr = obs.current_corr() or f"fleet-{next(self._corr_ids)}"
         hedged: Dict[str, Any] = {}
         with obs.span("router.dispatch", corr=corr, mode=mode,
-                      priority=priority) as sp:
+                      priority=priority, tenant=tenant) as sp:
             link = (sp.trace, sp.span_id) if sp.trace else None
             t1 = time.monotonic()    # admission done; dispatch begins
             for attempt in range(budget):
@@ -1198,20 +1352,21 @@ class Router:
                     raise DeadlineExpired(
                         f"deadline exhausted after {attempt} "
                         f"attempt(s)")
-                if attempt > 0 and not self.retry_budget.spend():
+                if attempt > 0 and not tbudget.spend():
                     self.stats.count("budget_denied")
                     budget_stopped = True
                     break             # single-shot: first outcome stands
-                name = self._pick(tried)
+                name = self._pick(tried, family=family)
                 if name is None:
                     if attempt > 0:
-                        self.retry_budget.refund()
+                        tbudget.refund()
                     break
                 tried.add(name)
                 try:
                     winner, out = self._hedged_request(
                         name, mode, tokens, timeout, deadline,
-                        priority, corr=corr, link=link, info=hedged)
+                        priority, corr=corr, link=link, info=hedged,
+                        tenant=tenant, family=family)
                 except Overloaded as e:
                     # load, not failure: no strike, try a sibling
                     saturated += 1
@@ -1236,11 +1391,12 @@ class Router:
                     m = self._members.get(winner)
                     if m is not None:
                         m.dispatched += 1
-                self._shed_backoffs.reset(priority)
+                self._shed_backoffs.reset(priority, tenant=tenant)
                 self.stats.count("completed")
                 t2 = time.monotonic()
                 lat = t2 - t0
-                self.stats.observe_latency(lat, priority)
+                self.stats.observe_latency(lat, priority,
+                                           tenant=tenant)
                 # stage partition shares the e2e clock and its
                 # boundary stamps: admit + dispatch == latency exactly
                 self.stats.observe_stage("admit", t1 - t0)
@@ -1249,7 +1405,8 @@ class Router:
                 sp.set(engine=winner, attempts=attempt + 1)
                 self.requests.record(
                     corr=corr, trace=sp.trace or None, mode=mode,
-                    engine=winner, priority=priority, outcome="ok",
+                    engine=winner, priority=priority, tenant=tenant,
+                    outcome="ok",
                     latency_ms=round(lat * 1e3, 3),
                     hedged=bool(hedged), attempts=attempt + 1,
                     stages_ms={
@@ -1268,7 +1425,7 @@ class Router:
                 # the first attempt's outcome stands, the request is
                 # never shed BECAUSE of the budget
                 if isinstance(last_exc, Overloaded):
-                    self.stats.observe_shed(priority)
+                    self.stats.observe_shed(priority, tenant=tenant)
                     raise last_exc    # the engine's honest Retry-After
                 self.stats.count("failed")
                 raise EngineUnavailable(
@@ -1280,11 +1437,12 @@ class Router:
                    else "no healthy engine available"
                    if not tried else
                    f"all {len(tried)} reachable engine(s) failed")
-            self._shed(why, priority=priority)
+            self._shed(why, priority=priority, tenant=tenant)
 
     def _call_stream(self, name: str, tokens, timeout, max_new,
                      deadline, priority, cancel_event,
-                     resume_from: int = 0, trace=None):
+                     resume_from: int = 0, trace=None,
+                     tenant: str = "default"):
         with self._lock:
             m = self._members.get(name)
         if m is None:
@@ -1295,12 +1453,15 @@ class Router:
             {"timeout": timeout, "max_new": max_new,
              "deadline": deadline, "priority": priority,
              "cancel_event": cancel_event,
-             "resume_from": resume_from, "trace": trace})
+             "resume_from": resume_from, "trace": trace,
+             "tenant": tenant})
 
     def _hedged_stream(self, name: str, tokens, timeout, max_new,
                        deadline, priority,
                        corr: Optional[str] = None, link=None,
-                       info: Optional[dict] = None) -> tuple:
+                       info: Optional[dict] = None,
+                       tenant: str = "default",
+                       family: Optional[str] = None) -> tuple:
         """Streaming twin of `_hedged_request`: FIRST BYTE wins — each
         attempt admits its stream and pulls one event; whichever
         event lands first commits that engine, the loser's
@@ -1334,7 +1495,8 @@ class Router:
                         engine_name, tokens, timeout, max_new,
                         deadline, priority, ev,
                         trace=((asp.trace, asp.span_id)
-                               if asp.trace else None))
+                               if asp.trace else None),
+                        tenant=tenant)
                     first = next(gen)  # the first-byte commit
             except (Overloaded, DeadlineExpired, TimeoutError,
                     ValueError, Cancelled, StopIteration) as e:
@@ -1386,7 +1548,8 @@ class Router:
             except queue.Empty:
                 tried_hedge = True
                 hedge_name = self._try_hedge(
-                    set(cancels), cancels, launch, deadline)
+                    set(cancels), cancels, launch, deadline,
+                    tenant=tenant, family=family)
                 if hedge_name is not None:
                     pending.add(hedge_name)
                     if info is not None:
@@ -1426,7 +1589,9 @@ class Router:
     def route_stream(self, tokens, timeout: Optional[float] = None,
                      max_new: Optional[int] = None,
                      deadline: Optional[float] = None,
-                     priority: str = "interactive"):
+                     priority: str = "interactive",
+                     tenant: Optional[str] = None,
+                     model: Optional[str] = None):
         """Streaming dispatch: pick an engine exactly like `route`,
         but return its token-event iterator instead of a buffered
         result.  Retry-on-other-engine applies ONLY until the first
@@ -1436,6 +1601,8 @@ class Router:
         The engine's in-flight slot is held until the consumer
         exhausts (or abandons) the stream."""
         priority = qos.check_priority(priority)
+        tenant = self.tenancy.label(tenant)
+        family = self._check_family(model)
         if timeout is None:
             timeout = self.spec.request_timeout_s
         deadline = qos.resolve_deadline(timeout, deadline,
@@ -1450,11 +1617,13 @@ class Router:
             raise DeadlineExpired(
                 f"dead on arrival at router: deadline passed "
                 f"{-rem:.3f}s ago")
-        if self._brownout_sheds(priority):
+        if self._brownout_sheds(priority, tenant):
             self._shed(f"brownout sheds {priority}",
-                       priority=priority, brownout=True)
-        self.stats.count("routed")
-        self.retry_budget.earn()
+                       priority=priority, brownout=True,
+                       tenant=tenant)
+        self.stats.observe_routed(tenant)
+        tbudget = self.tenancy.budget(tenant)
+        tbudget.earn()
         budget = (self.spec.max_attempts
                   if self.spec.max_attempts > 0 else len(self._members))
         tried: set = set()
@@ -1469,7 +1638,7 @@ class Router:
         # (the consumer's pull cadence is not ours).  Post-admission
         # stages are recorded post-hoc against `link` at terminal.
         with obs.span("router.stream", corr=corr, mode="generate",
-                      priority=priority) as sp:
+                      priority=priority, tenant=tenant) as sp:
             link = (sp.trace, sp.span_id) if sp.trace else None
             pa = time.perf_counter()  # admission done; dispatch begins
             for attempt in range(budget):
@@ -1479,20 +1648,21 @@ class Router:
                     raise DeadlineExpired(
                         f"deadline exhausted after {attempt} "
                         f"attempt(s)")
-                if attempt > 0 and not self.retry_budget.spend():
+                if attempt > 0 and not tbudget.spend():
                     self.stats.count("budget_denied")
                     budget_stopped = True
                     break
-                name = self._pick(tried)
+                name = self._pick(tried, family=family)
                 if name is None:
                     if attempt > 0:
-                        self.retry_budget.refund()
+                        tbudget.refund()
                     break
                 tried.add(name)
                 try:
                     winner, first, gen, cancel = self._hedged_stream(
                         name, tokens, timeout, max_new, deadline,
-                        priority, corr=corr, link=link, info=hedged)
+                        priority, corr=corr, link=link, info=hedged,
+                        tenant=tenant, family=family)
                 except Overloaded as e:
                     saturated += 1
                     last_exc = e
@@ -1517,7 +1687,8 @@ class Router:
                     prompt=tokens, max_new=max_new, deadline=deadline,
                     priority=priority, engine=winner,
                     step=self.engine_step(winner), corr=corr,
-                    trace=link)
+                    trace=link, tenant=tenant,
+                    family=self.engine_family(winner))
                 leg = _StreamLeg(self, session, winner, gen, cancel,
                                  first=first)
                 sp.set(engine=winner, attempts=attempt + 1)
@@ -1527,7 +1698,7 @@ class Router:
                     link=link, hedged=bool(hedged))
         if budget_stopped and last_exc is not None:
             if isinstance(last_exc, Overloaded):
-                self.stats.observe_shed(priority)
+                self.stats.observe_shed(priority, tenant=tenant)
                 raise last_exc
             self.stats.count("failed")
             raise EngineUnavailable(
@@ -1538,7 +1709,7 @@ class Router:
                else "no healthy engine available"
                if not tried else
                f"all {len(tried)} reachable engine(s) failed")
-        self._shed(why, priority=priority)
+        self._shed(why, priority=priority, tenant=tenant)
 
     def _session_stream(self, session, leg, t0: float, priority: str,
                         timeout: Optional[float], p0=None, pa=None,
@@ -1593,7 +1764,9 @@ class Router:
             self.requests.record(
                 corr=session.corr, trace=link[0] if link else None,
                 mode="stream", engine=session.engine,
-                priority=priority, outcome=outcome,
+                priority=priority,
+                tenant=getattr(session, "tenant", "default"),
+                outcome=outcome,
                 latency_ms=round(lat * 1e3, 3), hedged=hedged,
                 resumes=session.resumes,
                 tokens=len(session.emitted),
@@ -1715,10 +1888,11 @@ class Router:
                     m = self._members.get(session.engine)
                     if m is not None:
                         m.dispatched += 1
-                self._shed_backoffs.reset(priority)
+                tenant = getattr(session, "tenant", "default")
+                self._shed_backoffs.reset(priority, tenant=tenant)
                 self.stats.count("completed")
                 self.stats.observe_latency(time.monotonic() - t0,
-                                           priority)
+                                           priority, tenant=tenant)
             else:
                 self.stats.count("failed")
 
@@ -1767,21 +1941,29 @@ class Router:
         if session.max_new is not None and \
                 session.next_i >= session.max_new:
             return None               # journal already complete
+        # the resume charges the tenant that OWNS the stream: one
+        # tenant's straggler storm of failovers drains its own floor
+        # and the shared bucket, never a neighbor's floor
+        tbudget = self.tenancy.budget(
+            getattr(session, "tenant", "default"))
         tried = {old_engine}
         while True:
-            if not self.retry_budget.spend():
+            if not tbudget.spend():
                 sstats.count("resume_denied")
                 self.stats.count("budget_denied")
                 raise err
-            name, other_steps = self._pick_resume(tried, session.step)
+            name, other_steps = self._pick_resume(
+                tried, session.step,
+                family=getattr(session, "family", None))
             if name is None:
-                self.retry_budget.refund()
+                tbudget.refund()
                 if other_steps:
                     raise _FailoverStale(
-                        f"no engine pinned to step {session.step} "
-                        f"remains (siblings serve a different "
-                        f"fingerprint); refusing to splice across "
-                        f"checkpoints") from err
+                        f"no engine pinned to fingerprint "
+                        f"({getattr(session, 'family', 'default')}, "
+                        f"{session.step}) remains (siblings serve a "
+                        f"different fingerprint); refusing to splice "
+                        f"across checkpoints") from err
                 sstats.count("resume_denied")
                 raise err
             tried.add(name)
@@ -1794,7 +1976,7 @@ class Router:
                     # would replay the stream from index 0 — degrade
                     # instead of splicing garbage
                     self._release(name)
-                    self.retry_budget.refund()
+                    tbudget.refund()
                     sstats.count("resume_denied")
                     raise err
             cancel = threading.Event()
@@ -1821,7 +2003,8 @@ class Router:
                         session.max_new, session.deadline,
                         session.priority, cancel, resume_from=at,
                         trace=((rsp.trace, rsp.span_id)
-                               if rsp.trace else None))
+                               if rsp.trace else None),
+                        tenant=getattr(session, "tenant", "default"))
                     first = next(gen)
             except Overloaded:
                 self._release(name)
@@ -1856,56 +2039,66 @@ class Router:
             return _StreamLeg(self, session, name, gen, cancel,
                               first=first)
 
-    def _pick_resume(self, exclude: set, step: int):
-        """Least-loaded healthy engine pinned to `step` (in-flight
-        slot taken), or (None, whether engines at OTHER steps exist)
-        — the caller's stale-vs-degrade decision."""
+    def _pick_resume(self, exclude: set, step: int,
+                     family: Optional[str] = None):
+        """Least-loaded healthy engine pinned to the `(family, step)`
+        fingerprint (in-flight slot taken), or (None, whether engines
+        at OTHER fingerprints exist) — the caller's stale-vs-degrade
+        decision.  `family=None` matches on step alone (legacy
+        sessions)."""
         with self._lock:
             cands = []
-            other_steps = False
+            other_fps = False
             for n, m in self._members.items():
                 if (n in exclude or not m.healthy or m.quarantined
                         or m.draining):
                     continue
-                if int(m.step) != int(step):
-                    other_steps = True
+                if int(m.step) != int(step) or (
+                        family is not None and m.family != family):
+                    other_fps = True
                     continue
                 cands.append((m.in_flight + m.queue_depth, n))
             if not cands:
-                return None, other_steps
+                return None, other_fps
             _, name = min(cands)
             self._members[name].in_flight += 1
-            return name, other_steps
+            return name, other_fps
 
     def _shed(self, why: str, priority: str = "interactive",
-              brownout: bool = False) -> None:
-        self.stats.observe_shed(priority, brownout=brownout)
-        retry = self._shed_backoffs.shed_delay(priority)
+              brownout: bool = False,
+              tenant: str = "default") -> None:
+        self.stats.observe_shed(priority, brownout=brownout,
+                                tenant=tenant)
+        retry = self._shed_backoffs.shed_delay(priority, tenant=tenant)
         # a shed is a terminal outcome: record it (corr/trace from
         # the enclosing dispatch span, when one is open) and keep its
         # trace — sheds are always interesting to the tail sampler
         tr = obs.trace_context()
         self.requests.record(
             corr=obs.current_corr(), trace=tr[0] if tr else None,
-            priority=priority, outcome="shed", why=why)
+            priority=priority, tenant=tenant, outcome="shed", why=why)
         if tr:
             obs.sample_trace(tr[0], 0.0, shed=True)
         obs.emit_event("serve.shed", why=f"router: {why}",
-                       priority=priority,
+                       priority=priority, tenant=tenant,
                        retry_after=round(retry, 4))
         raise Overloaded(f"request shed ({why}); retry after "
                          f"{retry:.3f}s", retry_after=retry)
 
     # -- rollout support ----------------------------------------------------
-    def pick_canary(self) -> Optional[str]:
+    def pick_canary(self, family: Optional[str] = None
+                    ) -> Optional[str]:
         """The engine to canary a new checkpoint on: healthy and
         carrying the LEAST traffic — a bad fingerprint should touch as
-        little of the fleet's load as possible."""
+        little of the fleet's load as possible.  `family` scopes the
+        choice to one checkpoint family's members (per-family rollout
+        canaries)."""
         with self._lock:
             cands = [(m.in_flight + m.queue_depth, n)
                      for n, m in self._members.items()
                      if m.healthy and not m.quarantined
-                     and not m.draining]
+                     and not m.draining
+                     and (family is None or m.family == family)]
         return min(cands)[1] if cands else None
 
     def snapshot(self) -> Dict[str, Any]:
@@ -1913,6 +2106,9 @@ class Router:
         out["engines"] = self.members()
         out["healthy_engines"] = len(self.healthy_names())
         out["streams"] = self.sessions.snapshot()
+        out["families"] = self.families()
+        out["by_tenant"] = self.stats.tenants.snapshot()
+        out["tenancy"] = self.tenancy.snapshot()
         return out
 
 

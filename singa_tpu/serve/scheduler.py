@@ -63,6 +63,7 @@ from .batcher import Cancelled, DeadlineExpired, Overloaded
 from .engine import InferenceEngine
 from .kvcache import PagedKVCache
 from .stats import ServeStats
+from .tenancy import TenantRegistry
 
 
 class StreamTicket:
@@ -142,6 +143,7 @@ class _CBRequest:
     deadline: Optional[float]
     corr: str
     priority: str = "interactive"
+    tenant: str = "default"
     cancel_event: Optional[threading.Event] = None
     t_admit: float = 0.0
     produced: List[int] = field(default_factory=list)
@@ -157,7 +159,8 @@ class ContinuousScheduler:
 
     def __init__(self, engine: InferenceEngine,
                  stats: Optional[ServeStats] = None, log_fn=print,
-                 backoff: Optional[faults.Backoff] = None):
+                 backoff: Optional[faults.Backoff] = None,
+                 tenancy: Optional[TenantRegistry] = None):
         if not engine.spec.cb_on:
             raise ValueError("ContinuousScheduler needs a cb=on "
                              "ServeSpec")
@@ -167,6 +170,8 @@ class ContinuousScheduler:
         self.log = log_fn
         self._backoff = backoff if backoff is not None else \
             faults.Backoff(base=0.05, cap=2.0, seed=self.spec.seed)
+        self.tenancy = tenancy if tenancy is not None \
+            else TenantRegistry()
         self.kv: Optional[PagedKVCache] = None
         self._pending: deque = deque()
         self._cv = threading.Condition()
@@ -248,6 +253,7 @@ class ContinuousScheduler:
                max_new: Optional[int] = None,
                deadline: Optional[float] = None,
                priority: str = "interactive",
+               tenant: Optional[str] = None,
                cancel_event: Optional[threading.Event] = None,
                resume_from: int = 0) -> StreamTicket:
         """Admit one generate request.  `max_new` caps this request's
@@ -274,6 +280,7 @@ class ContinuousScheduler:
         fast 400 (counted `rejected`, zero engine steps) — the
         original stream was already complete."""
         spec = self.spec
+        tenant = self.tenancy.label(tenant)
         arr = np.asarray(tokens, np.int32).reshape(-1)
         if arr.size < 1:
             self.stats.count("rejected")
@@ -336,55 +343,72 @@ class ContinuousScheduler:
                          ticket=StreamTicket(corr,
                                              first_index=resume_from),
                          t_submit=now, deadline=deadline, corr=corr,
-                         priority=priority, cancel_event=cancel_event,
-                         link=link)
+                         priority=priority, tenant=tenant,
+                         cancel_event=cancel_event, link=link)
+        quota = self.tenancy.queue_quota(tenant, spec.queue_capacity)
         with obs.span("scheduler.admit", corr=corr,
                       plen=int(arr.size), max_new=mn,
-                      priority=priority):
+                      priority=priority, tenant=tenant):
             try:
                 faults.maybe_fault("serve.admit")
             except faults.FaultError as e:
                 self._shed(f"admission fault: {e}", corr=corr,
-                           priority=priority)
+                           priority=priority, tenant=tenant)
             with self._cv:
                 if self._stop:
                     raise RuntimeError("scheduler is stopped")
                 depth = len(self._pending)
+                tdepth = sum(1 for r in self._pending
+                             if r.tenant == tenant)
                 if depth >= spec.queue_capacity or \
-                        not self._brownout_admits(priority, depth):
+                        tdepth >= quota or \
+                        not self._brownout_admits(priority, depth,
+                                                  tenant):
                     pass          # shed outside the happy path below
                 else:
                     self._pending.append(req)
-                    self._class_backoffs.reset(priority)
+                    self._class_backoffs.reset(priority,
+                                               tenant=tenant)
                     self.stats.count("submitted")
+                    self.stats.tenants.count("submitted", tenant)
                     self.stats.gauge("queue_depth", len(self._pending))
                     self._cv.notify()
                     return req.ticket
             if depth >= spec.queue_capacity:
                 why = f"queue full ({spec.queue_capacity} requests)"
+            elif tdepth >= quota:
+                why = (f"tenant {tenant} queue quota full "
+                       f"({tdepth}/{quota} of {spec.queue_capacity})")
             else:
                 why = (f"brownout: queue {depth}/"
                        f"{spec.queue_capacity} sheds {priority}")
-            self._shed(why, corr=corr, priority=priority)
+            self._shed(why, corr=corr, priority=priority,
+                       tenant=tenant)
 
-    def _brownout_admits(self, priority: str, depth: int) -> bool:
+    def _brownout_admits(self, priority: str, depth: int,
+                         tenant: str = "default") -> bool:
         """Class-aware admission under pressure: best_effort is shed
         once the pending queue is `brownout_be_frac` full, batch at
-        `brownout_batch_frac`; interactive rides to the cap."""
+        `brownout_batch_frac`; interactive rides to the cap.  A
+        tenant's spec can tighten either fraction for ITS traffic."""
         if priority == "interactive":
             return True
-        frac = (self.spec.brownout_be_frac
-                if priority == "best_effort"
-                else self.spec.brownout_batch_frac)
+        be, batch = self.tenancy.brownout_fracs(
+            tenant, self.spec.brownout_be_frac,
+            self.spec.brownout_batch_frac)
+        frac = be if priority == "best_effort" else batch
         return depth < max(int(frac * self.spec.queue_capacity), 1)
 
     def _shed(self, why: str, corr: Optional[str] = None,
-              priority: str = "interactive") -> None:
+              priority: str = "interactive",
+              tenant: str = "default") -> None:
         self.stats.count("shed")
         self.stats.count(f"shed_{priority}")
-        retry = self._class_backoffs.shed_delay(priority)
+        self.stats.tenants.count("shed", tenant)
+        retry = self._class_backoffs.shed_delay(priority,
+                                                tenant=tenant)
         obs.emit_event("serve.shed", why=why, corr=corr,
-                       priority=priority,
+                       priority=priority, tenant=tenant,
                        retry_after=round(retry, 4))
         raise Overloaded(f"request shed ({why}); retry after "
                          f"{retry:.3f}s", retry_after=retry)
@@ -446,17 +470,49 @@ class ContinuousScheduler:
                 f"queue"))
 
     def _admit_pending(self, params, step_no: int) -> None:
-        """Admit the queue head while a slot AND its blocks are free
-        (strict FIFO — a stuck head blocks, it is not overtaken)."""
+        """Admit the queue head while a slot AND its blocks are free.
+        FIFO with one tenancy carve-out: a head blocked ONLY by its
+        own tenant's slot/KV quota is stepped over (its quota is its
+        own blast radius — it must not wedge the other tenants), but
+        a head blocked by a GLOBAL resource (block pool too empty)
+        still holds everything behind it, preserving the
+        no-starvation guarantee for long prompts."""
         spec = self.spec
         while True:
             free = np.flatnonzero(~self._active)
             with self._cv:
                 if not self._pending or free.size == 0:
                     return
-                if not self.kv.can_admit(self._pending[0].nblocks):
-                    return
-                req = self._pending.popleft()
+                # per-tenant occupancy among the ACTIVE slots (slot
+                # count + conservative block reservations), once per
+                # admission round
+                slots_t: Dict[str, int] = {}
+                blocks_t: Dict[str, int] = {}
+                for r in self._slot_req:
+                    if r is not None:
+                        slots_t[r.tenant] = \
+                            slots_t.get(r.tenant, 0) + 1
+                        blocks_t[r.tenant] = \
+                            blocks_t.get(r.tenant, 0) + r.nblocks
+                req = None
+                for i, cand in enumerate(self._pending):
+                    if not self.kv.can_admit(cand.nblocks):
+                        # global pool pressure: the effective head
+                        # waits, nothing overtakes it
+                        return
+                    squota = self.tenancy.slot_quota(
+                        cand.tenant, spec.cb_slots)
+                    bquota = self.tenancy.kv_quota(
+                        cand.tenant, self.kv.usable_blocks)
+                    if slots_t.get(cand.tenant, 0) + 1 > squota or \
+                            blocks_t.get(cand.tenant, 0) + \
+                            cand.nblocks > bquota:
+                        continue  # ITS quota, not ours: step over
+                    req = cand
+                    del self._pending[i]
+                    break
+                if req is None:
+                    return        # every pending head is quota-held
                 self.stats.gauge("queue_depth", len(self._pending))
             # last-instant guard AFTER the pop, BEFORE any blocks or
             # engine work: an engine never prefills a request that is
@@ -564,9 +620,12 @@ class ContinuousScheduler:
         self.stats.observe_request(req.t_admit - req.t_submit,
                                    now - req.t_admit,
                                    len(req.produced))
+        self.stats.tenants.count("completed", req.tenant)
+        self.stats.tenants.observe_latency(now - req.t_submit,
+                                           req.tenant)
         obs.emit_event("serve.cb_retire", corr=req.corr,
                        finish=finish, tokens=len(req.produced),
-                       slot=slot)
+                       slot=slot, tenant=req.tenant)
         req.ticket._resolve({"tokens": list(req.produced),
                              "step": step_no, "finish": finish,
                              "slots": self.spec.cb_slots})

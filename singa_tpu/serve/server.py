@@ -69,6 +69,7 @@ from .batcher import DeadlineExpired, MicroBatcher, Overloaded
 from .engine import InferenceEngine, ServeSpec  # noqa: F401 (re-export)
 from .scheduler import ContinuousScheduler, StreamTicket
 from .stats import ServeStats  # noqa: F401 (re-export: stats mold)
+from .tenancy import TenantRegistry
 
 
 class InferenceServer:
@@ -80,14 +81,21 @@ class InferenceServer:
     def __init__(self, engine: InferenceEngine,
                  host: str = "127.0.0.1", port: int = 0,
                  http: bool = True, warmup_modes=("generate",),
-                 log_fn=print):
+                 log_fn=print,
+                 tenancy: Optional[TenantRegistry] = None):
         self.engine = engine
         self.stats = engine.stats
-        self.batcher = MicroBatcher(engine, log_fn=log_fn)
+        # ONE tenant registry per server, shared by both admission
+        # paths — quotas and brownout overrides agree by construction
+        self.tenancy = tenancy if tenancy is not None \
+            else TenantRegistry()
+        self.batcher = MicroBatcher(engine, log_fn=log_fn,
+                                    tenancy=self.tenancy)
         # cb=on: generate leaves the static buckets for the
         # continuous-batching scheduler (predict stays on the
         # batcher's bucket path)
-        self.scheduler = (ContinuousScheduler(engine, log_fn=log_fn)
+        self.scheduler = (ContinuousScheduler(engine, log_fn=log_fn,
+                                              tenancy=self.tenancy)
                           if engine.spec.cb_on else None)
         self.log = log_fn
         # per-server registry (not process-global: parallel tests each
@@ -183,6 +191,7 @@ class InferenceServer:
                  max_new: Optional[int] = None,
                  deadline: Optional[float] = None,
                  priority: str = "interactive",
+                 tenant: Optional[str] = None,
                  cancel_event: Optional[threading.Event] = None
                  ) -> Dict[str, Any]:
         """Submit one prompt and block for the decoded continuation.
@@ -198,12 +207,12 @@ class InferenceServer:
         if self.scheduler is not None:
             ticket = self.scheduler.submit(
                 tokens, timeout=timeout, max_new=max_new,
-                deadline=deadline, priority=priority,
+                deadline=deadline, priority=priority, tenant=tenant,
                 cancel_event=cancel_event)
         else:
             ticket = self.batcher.submit(
                 tokens, mode="generate", timeout=timeout,
-                deadline=deadline, priority=priority,
+                deadline=deadline, priority=priority, tenant=tenant,
                 cancel_event=cancel_event)
         out = ticket.wait(self._wait_budget(timeout, deadline))
         if self.scheduler is None and max_new is not None \
@@ -217,6 +226,7 @@ class InferenceServer:
                         max_new: Optional[int] = None,
                         deadline: Optional[float] = None,
                         priority: str = "interactive",
+                        tenant: Optional[str] = None,
                         cancel_event: Optional[threading.Event] = None,
                         resume_from: int = 0) -> StreamTicket:
         """Streaming admission (cb only): returns the request's
@@ -231,20 +241,21 @@ class InferenceServer:
                                "serve spec")
         return self.scheduler.submit(
             tokens, timeout=timeout, max_new=max_new,
-            deadline=deadline, priority=priority,
+            deadline=deadline, priority=priority, tenant=tenant,
             cancel_event=cancel_event, resume_from=resume_from)
 
     def predict(self, tokens,
                 timeout: Optional[float] = None,
                 deadline: Optional[float] = None,
                 priority: str = "interactive",
+                tenant: Optional[str] = None,
                 cancel_event: Optional[threading.Event] = None
                 ) -> Dict[str, Any]:
         """Next-token log-probs for one prompt (LM scoring)."""
         t0 = time.monotonic()
         ticket = self.batcher.submit(
             tokens, mode="predict", timeout=timeout,
-            deadline=deadline, priority=priority,
+            deadline=deadline, priority=priority, tenant=tenant,
             cancel_event=cancel_event)
         out = ticket.wait(self._wait_budget(timeout, deadline))
         out["latency_ms"] = round((time.monotonic() - t0) * 1e3, 3)
@@ -360,8 +371,14 @@ def _make_handler(server: InferenceServer):
                 priority = qos.check_priority(
                     req.get("priority")
                     or self.headers.get(qos.PRIORITY_HEADER))
+                # degrade-never-reject: a missing/garbled tenant id
+                # folds to "default" (check_tenant cannot raise)
+                tenant = qos.check_tenant(
+                    req.get("tenant")
+                    or self.headers.get(qos.TENANT_HEADER))
                 with obs.span("serve.request", trace=tr, parent=psid,
-                              mode=mode, priority=priority):
+                              mode=mode, priority=priority,
+                              tenant=tenant):
                     if mode == "generate":
                         max_new = req.get("max_new")
                         if max_new is not None:
@@ -370,18 +387,20 @@ def _make_handler(server: InferenceServer):
                                 server.scheduler is not None:
                             self._stream_generate(
                                 tokens, timeout, max_new, deadline,
-                                priority,
+                                priority, tenant=tenant,
                                 resume_from=int(
                                     req.get("resume_from", 0)))
                             return
                         out = server.generate(tokens, timeout=timeout,
                                               max_new=max_new,
                                               deadline=deadline,
-                                              priority=priority)
+                                              priority=priority,
+                                              tenant=tenant)
                     else:
                         out = server.predict(tokens, timeout=timeout,
                                              deadline=deadline,
-                                             priority=priority)
+                                             priority=priority,
+                                             tenant=tenant)
                 self._reply(200, out)
             except Overloaded as e:
                 self._reply(503, {"error": str(e),
@@ -400,7 +419,7 @@ def _make_handler(server: InferenceServer):
 
         def _stream_generate(self, tokens, timeout, max_new,
                              deadline=None, priority="interactive",
-                             resume_from=0) -> None:
+                             tenant=None, resume_from=0) -> None:
             """Chunked-transfer ndjson: one {"token": t, "i": n} line
             per produced token as the slot produces it (n the absolute
             sequence number — resume_from-based for a failover
@@ -415,6 +434,7 @@ def _make_handler(server: InferenceServer):
                                              max_new=max_new,
                                              deadline=deadline,
                                              priority=priority,
+                                             tenant=tenant,
                                              resume_from=resume_from)
             self.send_response(200)
             self.send_header("Content-Type", "application/x-ndjson")

@@ -96,14 +96,22 @@ class StreamSession:
     def __init__(self, sid: str, prompt: np.ndarray,
                  max_new: Optional[int], deadline: Optional[float],
                  priority: str, engine: str, step: int,
-                 corr: Optional[str] = None, trace=None):
+                 corr: Optional[str] = None, trace=None,
+                 tenant: str = "default",
+                 family: Optional[str] = None):
         self.sid = sid
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new = (int(max_new) if max_new is not None else None)
         self.deadline = deadline
         self.priority = priority
         self.engine = engine          # current leg's engine
-        self.step = int(step)         # serving fingerprint (ckpt step)
+        self.step = int(step)         # with `family`, the serving
+        self.family = family          # fingerprint (family, step) —
+                                      # a resume must match BOTH
+        # the tenant that owns the stream: every failover resume
+        # charges ITS retry budget, and the splice accounting lands
+        # on its singa_tenant_* series
+        self.tenant = tenant
         # trace context of the originating request — `(trace_id,
         # root_span_id)` — so a failover leg admitted seconds later on
         # a different thread still lands in the SAME trace, tagged
@@ -143,7 +151,8 @@ class StreamSession:
 
     def snapshot(self) -> Dict[str, Any]:
         return {"sid": self.sid, "engine": self.engine,
-                "step": self.step, "state": self.state,
+                "step": self.step, "family": self.family,
+                "tenant": self.tenant, "state": self.state,
                 "emitted": len(self.emitted),
                 "resumes": self.resumes,
                 "age_s": round(time.monotonic() - self.t0, 3)}
@@ -163,10 +172,12 @@ class SessionManager:
     def open(self, prompt, max_new: Optional[int],
              deadline: Optional[float], priority: str,
              engine: str, step: int, corr: Optional[str] = None,
-             trace=None) -> StreamSession:
+             trace=None, tenant: str = "default",
+             family: Optional[str] = None) -> StreamSession:
         sid = f"stream-{next(self._ids)}"
         s = StreamSession(sid, prompt, max_new, deadline, priority,
-                          engine, step, corr=corr, trace=trace)
+                          engine, step, corr=corr, trace=trace,
+                          tenant=tenant, family=family)
         with self._lock:
             self._sessions[sid] = s
         self.stats.count("opened")

@@ -40,6 +40,8 @@ import time
 from collections import deque
 from typing import Any, Dict, Optional
 
+from .tenancy import TenantCounts
+
 
 class ServeStats:
     """Thread-safe serving counters.  See module docstring."""
@@ -48,6 +50,11 @@ class ServeStats:
                  qps_window_s: float = 30.0):
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
+        # per-tenant engine-level accounting (serve/tenancy.py):
+        # bounded-cardinality labels, exported as singa_tenant_* by
+        # register_into.  Callers pass registry-FOLDED labels.
+        self.tenants = TenantCounts(
+            ("submitted", "completed", "shed"))
         self._latencies: deque = deque(maxlen=max(int(latency_window), 1))
         # the total-latency split (observe_request): time in queue
         # before dispatch/admission vs time being served, plus the
@@ -350,6 +357,10 @@ class ServeStats:
             return out
 
         registry.register_collector(collect)
+        # per-tenant labeled series (bounded cardinality — see
+        # tenancy.TenantCounts); engine-level registries never collide
+        # with the router's because each server owns its own registry
+        self.tenants.register_into(registry)
         # real histograms (cumulative le buckets + _sum/_count) next
         # to the reservoir quantiles: the reservoir gives honest
         # recent p50/p95, the histogram aggregates across scrapes and
@@ -435,4 +446,5 @@ class ServeStats:
             if cb_occ_recent is not None else None)
         out["cb_block_utilization"] = (round(cb_util, 4)
                                        if cb_util is not None else None)
+        out["by_tenant"] = self.tenants.snapshot()
         return out

@@ -63,6 +63,12 @@ class Phase:
     slow_reader_s: float = 0.0     # per-token consumer stall (streams)
     priorities: Tuple[str, ...] = ("interactive",)   # QoS class mix
     priority_weights: Optional[Tuple[float, ...]] = None
+    # multi-tenant mix: each arrival is attributed to one tenant id
+    # (weights normalized at sample time, like the other mixes) and
+    # the report breaks offered/completed/shed/p95 down per tenant —
+    # the isolation gate's raw data
+    tenants: Tuple[str, ...] = ("default",)
+    tenant_weights: Optional[Tuple[float, ...]] = None
     on_start: Optional[Callable[[], None]] = None   # chaos hook
 
     def __post_init__(self):
@@ -80,6 +86,15 @@ class Phase:
                 raise ValueError(f"phase {self.name!r}: unknown "
                                  f"priority {p!r} (want one of "
                                  f"{qos.PRIORITIES})")
+        if not self.tenants:
+            raise ValueError(f"phase {self.name!r}: tenants must "
+                             f"name at least one tenant")
+        if self.tenant_weights is not None and \
+                len(self.tenant_weights) != len(self.tenants):
+            raise ValueError(f"phase {self.name!r}: tenant_weights "
+                             f"must match tenants "
+                             f"({len(self.tenant_weights)} vs "
+                             f"{len(self.tenants)})")
 
     def rate_at(self, frac: float) -> float:
         """Instantaneous arrival rate `frac` of the way through."""
@@ -135,6 +150,11 @@ class _PhaseLog:
         self.completed_by_class: Dict[str, int] = {}
         self.shed_by_class: Dict[str, int] = {}
         self.lat_by_class: Dict[str, List[float]] = {}
+        # per-tenant attribution (the isolation gate's raw data)
+        self.offered_by_tenant: Dict[str, int] = {}
+        self.completed_by_tenant: Dict[str, int] = {}
+        self.shed_by_tenant: Dict[str, int] = {}
+        self.lat_by_tenant: Dict[str, List[float]] = {}
         # per-stream delivery audit (the failover exactly-once gate):
         # duplicate/out-of-order indices and spliced terminals seen by
         # the CLIENT side of the harness
@@ -241,6 +261,14 @@ class TrafficGen:
         w = np.asarray(phase.priority_weights, dtype=np.float64)
         return str(rng.choice(list(phase.priorities), p=w / w.sum()))
 
+    def _pick_tenant(self, rng, phase: Phase) -> str:
+        if len(phase.tenants) == 1:
+            return phase.tenants[0]
+        if phase.tenant_weights is None:
+            return str(rng.choice(list(phase.tenants)))
+        w = np.asarray(phase.tenant_weights, dtype=np.float64)
+        return str(rng.choice(list(phase.tenants), p=w / w.sum()))
+
     def _fire(self, phase: Phase, log: _PhaseLog, rng_seed: int) -> None:
         rng = np.random.default_rng(rng_seed)
         plen = self._sample(rng, phase.prompt_lens,
@@ -250,14 +278,20 @@ class TrafficGen:
         as_stream = (self.stream_fn is not None
                      and rng.random() < float(phase.stream_p))
         pri = self._pick_priority(rng, phase)
+        ten = self._pick_tenant(rng, phase)
         # Back-compat: plain `request_fn(tokens)` targets (tests wrap
-        # bare lambdas) only see the kwarg when the phase actually
-        # mixes classes — "interactive" is every layer's default.
+        # bare lambdas) only see a kwarg when the phase actually
+        # mixes classes/tenants — "interactive"/"default" is every
+        # layer's default.
         kw: Dict[str, Any] = {} if pri == "interactive" \
             else {"priority": pri}
+        if ten != "default":
+            kw["tenant"] = ten
         with self._lock:
             log.offered_by_class[pri] = \
                 log.offered_by_class.get(pri, 0) + 1
+            log.offered_by_tenant[ten] = \
+                log.offered_by_tenant.get(ten, 0) + 1
         t0 = time.monotonic()
         try:
             if as_stream:
@@ -286,6 +320,8 @@ class TrafficGen:
                 log.shed += 1
                 log.shed_by_class[pri] = \
                     log.shed_by_class.get(pri, 0) + 1
+                log.shed_by_tenant[ten] = \
+                    log.shed_by_tenant.get(ten, 0) + 1
             return
         except Exception as e:  # noqa: BLE001 — non-shed failure
             with self._lock:
@@ -300,6 +336,9 @@ class TrafficGen:
             log.completed_by_class[pri] = \
                 log.completed_by_class.get(pri, 0) + 1
             log.lat_by_class.setdefault(pri, []).append(lat)
+            log.completed_by_tenant[ten] = \
+                log.completed_by_tenant.get(ten, 0) + 1
+            log.lat_by_tenant.setdefault(ten, []).append(lat)
 
     def _spawn(self, phase: Phase, log: _PhaseLog, seed: int) -> None:
         with self._lock:
@@ -392,6 +431,20 @@ class TrafficGen:
             }
         return out
 
+    def _by_tenant(self, log: _PhaseLog) -> Dict[str, Dict[str, Any]]:
+        out: Dict[str, Dict[str, Any]] = {}
+        for ten in sorted(set(log.offered_by_tenant)
+                          | set(log.shed_by_tenant)
+                          | set(log.completed_by_tenant)):
+            lats = log.lat_by_tenant.get(ten, [])
+            out[ten] = {
+                "offered": log.offered_by_tenant.get(ten, 0),
+                "completed": log.completed_by_tenant.get(ten, 0),
+                "shed": log.shed_by_tenant.get(ten, 0),
+                "p95_ms": self._quantile(lats, 0.95),
+            }
+        return out
+
     def _report(self, logs: List[_PhaseLog],
                 phases: Sequence[Phase]) -> Dict[str, Any]:
         out_phases = []
@@ -418,6 +471,7 @@ class TrafficGen:
                     "stream_dup": log.stream_dup,
                     "stream_gap": log.stream_gap,
                     "by_class": self._by_class(log),
+                    "by_tenant": self._by_tenant(log),
                     "errors": list(log.errors),
                 }
             out_phases.append(row)
@@ -436,11 +490,18 @@ class TrafficGen:
                         (tot.offered_by_class, log.offered_by_class),
                         (tot.completed_by_class,
                          log.completed_by_class),
-                        (tot.shed_by_class, log.shed_by_class)):
+                        (tot.shed_by_class, log.shed_by_class),
+                        (tot.offered_by_tenant,
+                         log.offered_by_tenant),
+                        (tot.completed_by_tenant,
+                         log.completed_by_tenant),
+                        (tot.shed_by_tenant, log.shed_by_tenant)):
                     for pri, n in d_log.items():
                         d_tot[pri] = d_tot.get(pri, 0) + n
                 for pri, ls in log.lat_by_class.items():
                     tot.lat_by_class.setdefault(pri, []).extend(ls)
+                for ten, ls in log.lat_by_tenant.items():
+                    tot.lat_by_tenant.setdefault(ten, []).extend(ls)
         return {
             "phases": out_phases,
             "totals": {
@@ -458,6 +519,7 @@ class TrafficGen:
                 "stream_dup": tot.stream_dup,
                 "stream_gap": tot.stream_gap,
                 "by_class": self._by_class(tot),
+                "by_tenant": self._by_tenant(tot),
                 "errors": tot.errors[:10],
             },
         }

@@ -179,8 +179,8 @@ def test_router_stats_window_excludes_old_samples():
     rs = RouterStats(window_s=1.0)
     now = time.monotonic()
     rs._t0 = now - 100.0          # fake uptime so the cap won't bite
-    rs._routed_t.append(now - 50.0)       # ancient
-    rs._done_t.append((now - 50.0, 9.9, "interactive"))  # ancient
+    rs._routed_t.append((now - 50.0, "default"))            # ancient
+    rs._done_t.append((now - 50.0, 9.9, "interactive", "default"))
     rs.count("routed")
     rs.observe_latency(0.005)
     w = rs.windowed(1.0)

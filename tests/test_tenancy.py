@@ -1,0 +1,491 @@
+"""Multi-tenant QoS isolation (singa_tpu/serve/tenancy.py plus the
+admission paths that enforce it): tenant registry grammar and label
+folding, retry-budget floors under cross-tenant drain, per-(tenant,
+class) Retry-After streaks, quota enforcement at the continuous
+scheduler, model-aware routing with honest fast 404s, bounded
+`singa_tenant_*` label cardinality under a tenant-id fuzzer, the
+autoscaler's quota-weighted shed signal, traffic-harness tenant
+mixes, and the flight recorder's per-tenant shed-storm trigger.
+
+Correctness anchors:
+  * one tenant's retry storm can drain the SHARED budget bucket but
+    never another tenant's guaranteed floor;
+  * a hostile tenant-id fuzzer cannot grow /metrics: unconfigured ids
+    fold into `other` and nothing is dropped on fold (the accounting
+    identity: per-tenant sums equal the totals);
+  * an unserved model family is an honest fast 404 (UnknownModel) —
+    never a strike, never a shed charged to capacity.
+
+Cost control: everything below runs on stub handles or pure
+datastructures except ONE module-scoped cb engine (the test_cb.py
+mold) used to pin tenant queue-quota shedding at the real scheduler."""
+
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from singa_tpu.core.net import build_net
+from singa_tpu.models.transformer import transformer_lm
+from singa_tpu.obs.flightrec import FlightRecorder
+from singa_tpu.obs.metrics import MetricsRegistry, parse_prometheus
+from singa_tpu.serve import (EngineUnavailable, InferenceEngine,
+                             InferenceServer, Overloaded, Router,
+                             RouterSpec, ServeSpec, TenantBudget,
+                             TenantRegistry, TenantSpec, UnknownModel)
+from singa_tpu.serve import qos
+from singa_tpu.serve.autoscale import AutoScaler, AutoScaleSpec
+from singa_tpu.serve.qos import ClassBackoffs, RetryBudget
+from singa_tpu.serve.tenancy import TenantCounts
+from singa_tpu.serve.traffic import Phase, TrafficGen, steady
+
+pytestmark = pytest.mark.tenancy
+
+VOCAB, SEQ = 64, 16
+SHAPES = {"data": {"input": (SEQ,), "target": (SEQ,)}}
+
+
+def _net_and_params(seed=0):
+    cfg = transformer_lm(vocab_size=VOCAB, num_layers=2, embed_dim=32,
+                         num_heads=4, head_dim=8, seq_len=SEQ,
+                         batchsize=2)
+    net = build_net(cfg, "kTest", SHAPES)
+    return net, net.init_params(jax.random.PRNGKey(seed))
+
+
+# -- spec grammar and label folding ------------------------------------------
+
+def test_tenant_registry_parse_grammar():
+    reg = TenantRegistry.parse(
+        "a,queue_frac=0.25,budget_floor=4;b,queue_frac=0.5")
+    assert reg.names() == ("a", "b", "default", "other")
+    assert reg.spec_for("a").queue_frac == 0.25
+    assert reg.spec_for("a").budget_floor == 4.0
+    assert reg.spec_for("b").queue_frac == 0.5
+    # default/other exist unconfigured: no floor, no quota
+    assert reg.spec_for("default").budget_floor == 0.0
+    assert reg.spec_for(None).queue_frac == 1.0
+    assert TenantRegistry.parse(None).names() == ("default", "other")
+    with pytest.raises(ValueError, match="bad tenant spec entry"):
+        TenantRegistry.parse("a,bogus=1")
+    with pytest.raises(ValueError, match="bad tenant spec value"):
+        TenantRegistry.parse("a,queue_frac=wide")
+    with pytest.raises(ValueError, match="bad tenant name"):
+        TenantRegistry.parse("Team A,queue_frac=0.5")
+    with pytest.raises(ValueError, match="queue_frac"):
+        TenantRegistry.parse("a,queue_frac=0")
+    with pytest.raises(ValueError, match="duplicate"):
+        TenantRegistry(
+            [TenantSpec(name="a"), TenantSpec(name="a")])
+
+
+def test_check_tenant_degrades_never_rejects():
+    # missing/blank -> the legacy default tenant; garbage is
+    # sanitized, not 400'd — tenancy is isolation, not auth
+    assert qos.check_tenant(None) == "default"
+    assert qos.check_tenant("   ") == "default"
+    assert qos.check_tenant("  Team-A!! ") == "team-a__"
+    assert qos.check_tenant("a" * 200) == "a" * 64
+    assert qos.check_tenant("ünïcode") == "_n_code"
+
+
+def test_label_folding_bounds_unconfigured_ids():
+    reg = TenantRegistry.parse("a,queue_frac=0.5")
+    assert reg.label("a") == "a"
+    assert reg.label(None) == "default"
+    assert reg.label("никто") == "other"
+    assert reg.label("fuzz-9000") == "other"
+    # `other` may be configured explicitly to clamp what the
+    # unconfigured collectively get
+    clamped = TenantRegistry.parse("other,queue_frac=0.125")
+    assert clamped.spec_for("fuzz-9000").queue_frac == 0.125
+
+
+def test_quota_arithmetic_floors_at_one():
+    reg = TenantRegistry.parse(
+        "a,queue_frac=0.25,slot_frac=0.5,kv_frac=0.01")
+    assert reg.queue_quota("a", 8) == 2
+    assert reg.slot_quota("a", 2) == 1
+    # a quota can never starve a tenant of its last unit
+    assert reg.kv_quota("a", 10) == 1
+    assert reg.queue_quota("default", 8) == 8
+    assert reg.share("a") == 0.25 and reg.share("default") == 1.0
+
+
+def test_brownout_fracs_inherit_and_override():
+    reg = TenantRegistry.parse("a,brownout_batch_frac=0.125")
+    # 0.0 = inherit the engine's fraction; > 0 = tenant override
+    assert reg.brownout_fracs("a", 0.5, 0.75) == (0.5, 0.125)
+    assert reg.brownout_fracs("default", 0.5, 0.75) == (0.5, 0.75)
+
+
+# -- retry-budget floors -----------------------------------------------------
+
+def test_budget_floor_survives_other_tenants_drain():
+    shared = RetryBudget(ratio=0.1, burst=8.0)
+    reg = TenantRegistry.parse("a,budget_floor=4;b,budget_floor=3")
+    with pytest.raises(RuntimeError, match="bind_budgets"):
+        reg.budget("a")
+    reg.bind_budgets(shared)
+    ba, bb = reg.budget("a"), reg.budget("b")
+    # tenant A drains its own floor AND the whole shared bucket dry
+    drained = 0
+    while ba.spend() and drained < 10_000:
+        drained += 1
+    assert drained == 12                   # 4 floor + 8 shared burst
+    assert not ba.spend()
+    # B's guaranteed floor is untouched by A's storm
+    for _ in range(3):
+        assert bb.spend()
+    assert not bb.spend()                  # floor dry, shared dry
+    # an unconfigured tenant has no floor: pure shared behavior
+    assert not reg.budget("fuzz").spend()
+
+
+def test_budget_earn_tops_floor_then_overflows_shared():
+    shared = RetryBudget(ratio=0.5, burst=4.0)
+    b = TenantBudget(shared, floor=2.0)
+    while shared.spend():                  # shared dry, floor full
+        pass
+    assert b.tokens() == 2.0
+    assert b.spend() and b.spend() and not b.spend()
+    b.earn(2)                              # 2 * ratio = 1.0 -> floor
+    assert b.tokens() == pytest.approx(1.0)
+    assert shared.tokens() == pytest.approx(0.0)
+    b.earn(4)                              # 1.0 tops the floor, then
+    assert b.tokens() == pytest.approx(2.0)  # 1.0 overflows shared
+    assert shared.tokens() == pytest.approx(1.0)
+
+
+def test_budget_refund_refills_floor_first():
+    shared = RetryBudget(ratio=0.5, burst=4.0)
+    b = TenantBudget(shared, floor=2.0)
+    assert b.spend(2.0) and b.tokens() == 0.0
+    before = shared.tokens()
+    b.refund(3.0)                          # 2 to the floor, 1 shared
+    assert b.tokens() == pytest.approx(2.0)
+    assert shared.tokens() == pytest.approx(min(before + 1.0, 4.0))
+
+
+# -- per-(tenant, class) Retry-After streaks ---------------------------------
+
+def test_streaks_scoped_per_tenant_and_class():
+    cb = ClassBackoffs(base=0.05, cap=2.0, seed=0)
+    # the pre-tenancy regression: ANY successful dispatch used to
+    # reset the escalation streak for everyone, so a busy tenant's
+    # completions masked another tenant's congestion
+    delays = [cb.shed_delay("interactive", tenant="a")
+              for _ in range(4)]
+    assert cb.streak("interactive", tenant="a") == 4
+    # strictly escalating: base*2^k dominates the +-25% jitter
+    assert delays[2] > delays[0] and delays[3] > delays[1]
+    # another tenant's success, and this tenant's OTHER class, leave
+    # the streak alone
+    cb.reset("interactive", tenant="b")
+    cb.reset("batch", tenant="a")
+    assert cb.streak("interactive", tenant="a") == 4
+    # only (a, interactive)'s own admission ends its streak
+    cb.reset("interactive", tenant="a")
+    assert cb.streak("interactive", tenant="a") == 0
+    d0 = cb.shed_delay("interactive", tenant="a")
+    assert d0 < delays[3]
+
+
+def test_streak_tenant_cap_folds_to_other():
+    cb = ClassBackoffs(base=0.05, cap=2.0, max_tenants=2)
+    cb.shed_delay("interactive", tenant="a")     # 2nd tenant (default
+    cb.shed_delay("interactive", tenant="t-3")   # preseeded) -> other
+    cb.shed_delay("interactive", tenant="t-4")   # -> other too
+    assert cb.streak("interactive", tenant="t-9000") == 2
+
+
+# -- bounded label cardinality (the tenant-id fuzzer) ------------------------
+
+def test_tenant_counts_fuzz_bounded_and_nothing_dropped():
+    tc = TenantCounts(("shed",), max_tenants=64)
+    for i in range(10_000):
+        tc.count("shed", f"fuzz-{i}")
+    labels = tc.tenants()
+    assert len(labels) <= 64
+    assert "other" in labels
+    # the accounting identity: folding drops NOTHING — every count
+    # lands under some label, overflow under `other`
+    assert tc.totals()["shed"] == 10_000
+    assert sum(tc.get("shed", t) for t in labels) == 10_000
+    assert tc.get("shed", "other") >= 10_000 - 64
+    with pytest.raises(ValueError, match="unknown tenant counter"):
+        tc.count("bogus", "a")
+
+
+def test_tenant_metrics_series_bounded_and_parse_roundtrip():
+    tc = TenantCounts(("routed", "shed"), max_tenants=64)
+    reg = MetricsRegistry()
+    tc.register_into(reg)
+    for i in range(10_000):
+        tc.count("shed", f"fuzz-{i}")
+        tc.observe_latency(0.01, f"fuzz-{i}")
+    text = reg.render_prometheus()
+    parsed = parse_prometheus(text)        # raises on a garbled line
+    shed = {k: v for k, v in parsed.items()
+            if k.startswith("singa_tenant_shed_total")}
+    # bounded series: at most max_tenants labels ever hit /metrics
+    assert 0 < len(shed) <= 64
+    assert len([k for k in parsed
+                if k.startswith("singa_tenant_")]) <= 64 * 3
+    # /metrics agrees with the counters: the fuzz total survives the
+    # render -> parse roundtrip intact
+    assert sum(shed.values()) == 10_000
+    assert parsed['singa_tenant_shed_total{tenant="other"}'] \
+        >= 10_000 - 64
+
+
+# -- model-aware routing (stub handles, the test_fleet.py mold) --------------
+
+class StubHandle:
+    def __init__(self, name, family="default", step=1):
+        self.name = name
+        self.family = family
+        self.step = step
+        self.fail_probe = False
+        self.overloaded = False
+        self.served = 0
+
+    def probe(self):
+        if self.fail_probe:
+            raise EngineUnavailable(f"{self.name} is down")
+        return {"ok": True, "status": "ok", "step": self.step,
+                "queue_depth": 0, "family": self.family}
+
+    def stats_snapshot(self):
+        return {"completed": self.served, "failed": 0, "expired": 0,
+                "p95_latency_ms": None}
+
+    def request(self, mode, tokens, timeout=None):
+        if self.overloaded:
+            raise Overloaded(f"{self.name} full", retry_after=0.01)
+        self.served += 1
+        return {"tokens": [1, 2], "step": self.step}
+
+    def reload(self, step=None):
+        return {"outcome": "unchanged", "step": self.step}
+
+
+def _router(stubs, tenancy=None, **spec_kw):
+    spec_kw.setdefault("quarantine_after", 2)
+    spec_kw.setdefault("readmit_base_s", 0.01)
+    spec_kw.setdefault("readmit_cap_s", 0.02)
+    r = Router(stubs, spec=RouterSpec(**spec_kw), tenancy=tenancy,
+               log_fn=lambda s: None)
+    r.probe_all()
+    return r
+
+
+def test_unknown_model_is_fast_404_never_a_strike():
+    stubs = [StubHandle("e0"), StubHandle("e1")]
+    r = _router(stubs)
+    with pytest.raises(UnknownModel, match="llama"):
+        r.route("generate", [1, 2], model="llama")
+    assert r.stats.unknown_model == 1
+    # honest 404, not a failure: nobody was struck, nothing was shed
+    assert all(m["strikes"] == 0 for m in r.members())
+    assert r.stats.shed == 0 and r.stats.failed == 0
+    # UnknownModel is a ValueError for duck-typed callers (HTTP 404
+    # branch is checked before the generic 400)
+    assert isinstance(UnknownModel("x"), ValueError)
+
+
+def test_family_scoped_dispatch_and_canary():
+    stubs = [StubHandle("e0", family="llama"),
+             StubHandle("e1", family="gemma"),
+             StubHandle("e2", family="llama")]
+    r = _router(stubs)
+    assert r.families() == ["gemma", "llama"]
+    for _ in range(4):
+        out = r.route("generate", [1, 2], model="gemma")
+        assert out["engine"] == "e1"
+    assert stubs[1].served == 4 and stubs[0].served == 0
+    # family name is case/space-normalized like ServeSpec.family
+    out = r.route("generate", [1, 2], model="  LLaMA ")
+    assert out["engine"] in ("e0", "e2")
+    assert r.engine_family("e1") == "gemma"
+    assert r.pick_canary(family="gemma") == "e1"
+
+
+def test_quarantined_family_sheds_honestly_not_404():
+    stubs = [StubHandle("e0", family="llama"),
+             StubHandle("e1", family="gemma")]
+    r = _router(stubs, quarantine_after=1)
+    stubs[1].fail_probe = True
+    r.probe_all()                          # gemma is struck out...
+    assert any(m["quarantined"] for m in r.members())
+    # ...but still SERVED: mid-quarantine is overload, not absence —
+    # a 404 would tell clients to drop a family that is coming back
+    with pytest.raises(Overloaded):
+        r.route("generate", [1, 2], model="gemma")
+    assert r.stats.unknown_model == 0
+
+
+def test_router_tenant_accounting_and_sheds():
+    reg = TenantRegistry.parse("a,queue_frac=0.25")
+    stubs = [StubHandle("e0")]
+    r = _router(stubs, tenancy=reg)
+    r.route("generate", [1, 2], tenant="a")
+    r.route("generate", [1, 2], tenant="fuzz-77")   # folds to other
+    stubs[0].overloaded = True
+    with pytest.raises(Overloaded) as ei:
+        r.route("generate", [1, 2], tenant="a")
+    assert ei.value.retry_after > 0
+    snap = r.stats.snapshot()["by_tenant"]
+    assert snap["a"]["routed"] == 2 and snap["a"]["completed"] == 1
+    assert snap["a"]["shed"] == 1
+    assert snap["other"]["completed"] == 1
+    win = r.stats.windowed(60.0)
+    assert win["shed_by_tenant"]["a"] == 1
+
+
+# -- autoscaler: quota-weighted shed signal ----------------------------------
+
+class _SignalFleet:
+    def __init__(self, tenancy=None):
+        self.router = _router([StubHandle("e0")], tenancy=tenancy)
+        self.rollout = None
+
+
+def test_autoscale_shed_signal_weighted_by_tenant_share():
+    reg = TenantRegistry.parse("a,queue_frac=0.25")
+    fleet = _SignalFleet(tenancy=reg)
+    sc = AutoScaler(fleet, spec=AutoScaleSpec(window_s=60.0),
+                    log_fn=lambda s: None)
+    st = fleet.router.stats
+    # 2 interactive sheds charged to quota-limited tenant a (share
+    # 0.25), 2 to default (share 1.0): a tenant overflowing its OWN
+    # entitlement is containment working, not a capacity signal
+    for _ in range(2):
+        st.observe_shed("interactive", tenant="a")
+        st.observe_shed("interactive", tenant="default")
+    sig = sc.signals()
+    assert sig["tenant_shed_factor"] == pytest.approx(0.625)
+    # shed_rate carries the discount: 4 interactive sheds * 0.625,
+    # over max(routed, 1) = 1 routed
+    assert sig["shed_rate"] == pytest.approx(2.5)
+
+
+def test_autoscale_shed_signal_legacy_without_tenancy():
+    fleet = _SignalFleet()                 # default registry: share 1
+    sc = AutoScaler(fleet, spec=AutoScaleSpec(window_s=60.0),
+                    log_fn=lambda s: None)
+    fleet.router.stats.observe_shed("interactive")
+    sig = sc.signals()
+    assert sig["tenant_shed_factor"] == 1.0
+
+
+# -- traffic harness: per-phase tenant mixes ---------------------------------
+
+def test_phase_tenant_mix_validation():
+    p = steady("s", 1.0, 2.0, tenants=("a", "b"),
+               tenant_weights=(3.0, 1.0))
+    assert p.tenants == ("a", "b")
+    with pytest.raises(ValueError, match="tenant_weights"):
+        Phase(name="x", duration_s=1.0, rate_rps=1.0,
+              tenants=("a", "b"), tenant_weights=(1.0,))
+    with pytest.raises(ValueError, match="tenants"):
+        Phase(name="x", duration_s=1.0, rate_rps=1.0, tenants=())
+
+
+def test_traffic_attributes_per_tenant_and_omits_default_kwarg():
+    seen = []
+
+    def fn(toks, **kw):
+        seen.append(kw.get("tenant"))
+        return {"tokens": [1]}
+
+    gen = TrafficGen(fn, vocab=8, seed=0, log_fn=lambda s: None)
+    rep = gen.run([steady("mix", 1.2, 30.0, prompt_lens=(2,),
+                          tenants=("a", "default"),
+                          tenant_weights=(1.0, 1.0))],
+                  drain_timeout_s=10.0)
+    by = rep["phases"][0]["by_tenant"]
+    assert set(by) <= {"a", "default"} and "a" in by
+    assert by["a"]["completed"] == by["a"]["offered"]
+    # legacy clients stay legacy: the default tenant is sent as NO
+    # kwarg at all (request_fn signatures from PR 11 keep working)
+    assert None in seen and "a" in seen and "default" not in seen
+    tot = rep["totals"]["by_tenant"]
+    assert sum(r["offered"] for r in tot.values()) == \
+        rep["totals"]["offered"]
+
+
+# -- flight recorder: per-tenant shed storm ----------------------------------
+
+def test_flightrec_tenant_shed_storm_fires_on_diluted_burst(tmp_path):
+    fr = FlightRecorder(str(tmp_path), cooldown_s=0.0)
+    # a slow background of other-tenant sheds dilutes the global
+    # window below its threshold...
+    t0 = time.monotonic() - 60.0
+    for i in range(4):
+        fr._shed_ts.append((t0 + i, "b"))
+    # ...while tenant a absorbs a rapid burst: ITS storm, not b's
+    paths = [fr.observe("serve.shed", {"tenant": "a"})
+             for _ in range(12)]
+    assert all(p is None for p in paths[:11])
+    assert paths[11] and "tenant_shed_storm" in \
+        os.path.basename(paths[11])
+
+
+def test_flightrec_single_tenant_burst_stays_global_storm(tmp_path):
+    # no dilution -> the plain shed_storm fires at 16, exactly the
+    # pre-tenancy contract (test_trace.py pins the same behavior)
+    fr = FlightRecorder(str(tmp_path), cooldown_s=0.0)
+    paths = [fr.observe("serve.shed", {"tenant": "a"})
+             for _ in range(16)]
+    assert all(p is None for p in paths[:15])
+    assert paths[15] and "shed_storm" in os.path.basename(paths[15])
+    assert "tenant_shed_storm" not in os.path.basename(paths[15])
+
+
+# -- tenant queue quota at the real scheduler --------------------------------
+
+@pytest.fixture(scope="module")
+def cb_tenant_engine():
+    net, params = _net_and_params()
+    # the test_cb.py cb_small geometry: one worst-case request holds
+    # 33 of 39 pool blocks, so admission wedges deterministically
+    spec = ServeSpec(buckets=((2, SEQ),), max_new_tokens=128,
+                     temperature=0.0, queue_capacity=4,
+                     request_timeout_s=60.0,
+                     cb="on", cb_slots=2, cb_block_len=4, cb_blocks=40)
+    reg = TenantRegistry.parse("a,queue_frac=0.25;b,queue_frac=0.5")
+    engine = InferenceEngine(net, spec, params=params,
+                             log_fn=lambda s: None)
+    server = InferenceServer(engine, http=False, tenancy=reg,
+                             log_fn=lambda s: None)
+    server.start()
+    yield server, engine
+    server.stop()
+
+
+def test_scheduler_enforces_tenant_queue_quota(cb_tenant_engine):
+    server, engine = cb_tenant_engine
+    sched = server.scheduler
+    # a worst-case hog pins the pool: everything behind it queues
+    hog = server.generate_stream(np.array([6, 7, 8], np.int32),
+                                 tenant="a")
+    next(hog.tokens(timeout=30.0))
+    # tenant a's queue quota is max(int(0.25 * 4), 1) = 1: one queued
+    # request fits, the second is shed as A'S overflow...
+    q1 = server.generate_stream(np.array([2, 2, 2], np.int32),
+                                tenant="a")
+    with pytest.raises(Overloaded, match="tenant a queue quota"):
+        server.generate(np.array([3, 3, 3], np.int32), tenant="a")
+    # ...while tenant b still queues into the SAME engine: a's
+    # overflow is a's problem, not the fleet's
+    q2 = server.generate_stream(np.array([4, 4, 4], np.int32),
+                                tenant="b")
+    assert sched.stats.tenants.get("shed", "a") >= 1
+    assert sched.stats.tenants.get("shed", "b") == 0
+    for t in (hog, q1, q2):
+        assert len(t.wait(180.0)["tokens"]) == 128
+    assert sched.stats.tenants.get("completed", "a") >= 2
+    assert sched.stats.tenants.get("completed", "b") >= 1

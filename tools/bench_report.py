@@ -73,6 +73,13 @@ REQUIRED_GATES = {
         "restart_to_training", "hbm_watermark",
         "costwatch_compiles", "obs_overhead", "trajectory_renders",
     ),
+    "BENCH_pr18.json": (
+        "tenant_b_p95_isolated", "tenant_b_completion",
+        "tenant_b_zero_shed", "tenant_a_overloaded",
+        "tenant_a_shed_overflow", "tenant_a_retry_escalation",
+        "budget_floor_b_admitted", "budget_floor_a_exhausted",
+        "zero_failures", "zero_harness_drops",
+    ),
 }
 
 # --trajectory: tracked keys -> (direction, tolerance factor).  The
