@@ -2117,6 +2117,475 @@ def bench_failover_smoke(out=None):
     return result
 
 
+def bench_router_smoke(out=None):
+    """ISSUE 19 acceptance (docs/SERVING.md "Control-plane
+    durability"): the crash-safe control plane.  Five legs:
+
+      * RESTART leg (real SIGKILL, over HTTP): a one-worker fleet
+        router subprocess serves 3 concurrent 256-token streams;
+        once every client holds >= 32 tokens the router is SIGKILLed
+        — no atexit, no close records: the journal tail is whatever
+        the last group commit made durable — then restarted on the
+        same port over the same workspace.  Every client reconnects
+        with its session id + resume_from.  Gates: zero
+        client-visible failures, zero duplicate and zero missing
+        indices across the reconnect (exactly-once), every spliced
+        stream BIT-IDENTICAL to an uninterrupted reference, >= 3
+        streams recovered from the WAL;
+      * HANDOFF leg (over HTTP): primary + warm `standby=True`
+        router share one workspace; POST /admin/handoff mid-stream
+        lame-ducks the primary (the in-flight stream finishes; a
+        fresh admission gets 409 + the successor URL), POST
+        /admin/promote fences the old epoch and the promoted standby
+        serves the same prompt bit-identically;
+      * STATE leg: a quarantine bench and a per-(tenant, class) shed
+        streak survive an in-process router rebuild over the same
+        workspace — the control-state snapshot closes the
+        restart-launders-strikes hole;
+      * OVERHEAD leg: interleaved A/B of wal=on vs wal=off fleets,
+        gate: median stream tok/s ratio >= 0.97 (the WAL must cost
+        <= 3% of streaming throughput);
+      * WAL-FAULT leg: `router.wal@0:error` — the faulted group
+        commit degrades to counted lost durability (`wal_lost`); the
+        stream completes, a disk error never blocks a token.
+    `out` writes the JSON line (scripts/router_smoke.sh ->
+    BENCH_pr19.json)."""
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import jax
+
+    from singa_tpu.config import load_model_config
+    from singa_tpu.core.net import build_net
+    from singa_tpu.core.trainer import Trainer
+    from singa_tpu.data import discover_input_shapes
+    from singa_tpu.models.transformer import transformer_lm
+    from singa_tpu.serve import EngineFleet, FleetServer, RouterSpec, \
+        ServeSpec
+    from singa_tpu.utils.checkpoint import CheckpointManager
+    from singa_tpu.utils.faults import FaultSchedule, inject
+
+    vocab, plen, max_new = 64, 4, 256
+    seq = 272                        # net horizon >= plen + max_new
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def http_json(url, body=None, timeout=60.0):
+        req = urllib.request.Request(
+            url, data=(json.dumps(body).encode()
+                       if body is not None else None),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+
+    def http_stream(url, body, timeout=120.0):
+        req = urllib.request.Request(
+            url + "/generate", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        return urllib.request.urlopen(req, timeout=timeout)
+
+    # ---- leg 1: real SIGKILL restart over HTTP ----------------------
+    ws = tempfile.mkdtemp(prefix="router_smoke_")
+    with open(os.path.join(
+            repo, "examples/transformer/lm_tiny.conf")) as f:
+        conf_txt = f.read().replace("seq_len: 16", f"seq_len: {seq}")
+    conf = os.path.join(ws, "lm_smoke.conf")
+    with open(conf, "w") as f:
+        f.write(conf_txt)
+    model = load_model_config(conf)
+    shapes = discover_input_shapes(model, force_synthetic=True)
+    trainer = Trainer(model, shapes, log_fn=lambda s: None)
+    conf_net = trainer.test_net or trainer.train_net
+    conf_params = conf_net.init_params(jax.random.PRNGKey(0))
+    CheckpointManager(ws, log_fn=lambda s: None).save(
+        1, conf_params, {"t": np.zeros(())},
+        health={"verdict": "ok"})
+
+    port = free_port()
+    url = f"http://127.0.0.1:{port}"
+    cmd = [sys.executable, "-m", "singa_tpu.main", "serve",
+           "-model_conf", conf, "--workspace", ws,
+           "--fleet", "1", "--port", str(port),
+           "--serve_spec",
+           f"buckets=4x{seq},max_new_tokens={max_new},"
+           "batch_window_s=0.002,cb=on,cb_slots=4,cb_block_len=16",
+           "--fleet_spec",
+           "probe_period_s=0.2,hedge=off,request_timeout_s=120,"
+           "wal_group_tokens=8,wal_group_ms=5,state_snapshot_s=0.2"]
+    env = dict(os.environ, JAX_PLATFORMS=os.environ.get(
+        "JAX_PLATFORMS", "cpu"))
+
+    def launch():
+        return subprocess.Popen(cmd, cwd=repo, env=env,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+
+    def wait_healthy(proc, secs=600.0):
+        deadline = time.time() + secs
+        while True:
+            if proc.poll() is not None:
+                raise RuntimeError("router subprocess exited before "
+                                   "serving /healthz")
+            try:
+                st, _ = http_json(url + "/healthz", timeout=2.0)
+                if st == 200:
+                    return
+            except Exception:
+                pass
+            if time.time() > deadline:
+                proc.kill()
+                raise RuntimeError("router subprocess never became "
+                                   "healthy")
+            time.sleep(0.25)
+
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(1, vocab, size=plen).tolist()
+               for _ in range(3)]
+    proc = launch()
+    try:
+        wait_healthy(proc)
+        ref_http = []
+        for p in prompts:
+            toks = []
+            with http_stream(url, {"tokens": p, "stream": True,
+                                   "max_new": max_new}) as r:
+                for line in r:
+                    ev = json.loads(line)
+                    if "token" in ev:
+                        toks.append(int(ev["token"]))
+            ref_http.append(toks)
+
+        counts = [0] * 3
+        results = [None] * 3
+        lock = threading.Lock()
+
+        def client(k):
+            sid, seen, toks, err = None, [], [], None
+            try:
+                r = http_stream(url, {"tokens": prompts[k],
+                                      "stream": True,
+                                      "max_new": max_new})
+                for line in r:
+                    ev = json.loads(line)
+                    if sid is None and "sid" in ev:
+                        sid = ev["sid"]
+                    if "token" in ev:
+                        seen.append(int(ev["i"]))
+                        toks.append(int(ev["token"]))
+                        with lock:
+                            counts[k] += 1
+            except Exception as e:  # noqa: BLE001 — the SIGKILL cuts
+                err = f"{type(e).__name__}: {e}"   # the connection
+            with lock:
+                results[k] = {"sid": sid, "seen": seen, "toks": toks,
+                              "err": err}
+
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(3)]
+        for t in threads:
+            t.start()
+        while True:
+            with lock:
+                if all(c >= 32 for c in counts):
+                    break
+            time.sleep(0.005)
+        time.sleep(0.2)              # let a group commit reach disk
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(30)
+        for t in threads:
+            t.join(60.0)
+
+        proc = launch()
+        wait_healthy(proc)
+        r_fail = r_dup = r_missing = r_parity = 0
+        for k, res in enumerate(results):
+            if res is None or res["sid"] is None:
+                r_fail += 1
+                continue
+            seen, toks = list(res["seen"]), list(res["toks"])
+            try:
+                with http_stream(url, {"stream": True,
+                                       "session": res["sid"],
+                                       "resume_from": len(seen)}) as r:
+                    done = None
+                    for line in r:
+                        ev = json.loads(line)
+                        if ev.get("done"):
+                            done = ev
+                        if "token" in ev:
+                            seen.append(int(ev["i"]))
+                            toks.append(int(ev["token"]))
+            except Exception:
+                r_fail += 1
+                continue
+            if done is None or done.get("error"):
+                r_fail += 1
+            r_dup += len(seen) - len(set(seen))
+            r_missing += len(set(range(max_new)) - set(seen))
+            if toks != ref_http[k] or \
+                    (done or {}).get("tokens") != ref_http[k]:
+                r_parity += 1
+        _, snap = http_json(url + "/stats", timeout=10.0)
+        r_recovered = int((snap.get("wal") or {})
+                          .get("recovered_streams", 0))
+        restart_epoch = int(snap.get("epoch", 0))
+    finally:
+        proc.kill()
+        proc.wait(30)
+
+    # ---- shared in-process fixture for legs 2-5 ---------------------
+    cfg = transformer_lm(vocab_size=vocab, num_layers=2, embed_dim=32,
+                         num_heads=4, head_dim=8, seq_len=seq,
+                         batchsize=2)
+    net = build_net(cfg, "kTest",
+                    {"data": {"input": (seq,), "target": (seq,)}})
+    params = net.init_params(jax.random.PRNGKey(0))
+
+    def make_fleet(size, ws=None, standby=False, **rkw):
+        if ws is None:
+            ws = tempfile.mkdtemp(prefix="router_smoke_")
+            CheckpointManager(ws, log_fn=lambda s: None).save(
+                1, params, {"t": np.zeros(())},
+                health={"verdict": "ok"})
+        spec = ServeSpec(buckets=((2, seq),), max_new_tokens=max_new,
+                         batch_window_s=0.002,
+                         request_timeout_s=120.0, cb="on",
+                         cb_slots=3, cb_block_len=16)
+        rkw.setdefault("probe_period_s", 0.1)
+        rkw.setdefault("hedge", "off")
+        rkw.setdefault("request_timeout_s", 120.0)
+        fleet = EngineFleet.local(net, spec, size, workspace=ws,
+                                  params=params,
+                                  router_spec=RouterSpec(**rkw),
+                                  standby=standby,
+                                  log_fn=lambda s: None)
+        fleet.start()
+        return fleet, ws
+
+    def run_stream(front_url, prompt, mnew):
+        t0 = time.perf_counter()
+        toks, done, err = [], None, None
+        try:
+            with http_stream(front_url, {"tokens": prompt,
+                                         "stream": True,
+                                         "max_new": mnew}) as r:
+                for line in r:
+                    ev = json.loads(line)
+                    if ev.get("done"):
+                        done = ev
+                    if "token" in ev:
+                        toks.append(int(ev["token"]))
+        except Exception as e:  # noqa: BLE001 — gated below
+            err = f"{type(e).__name__}: {e}"
+        return {"toks": toks, "done": done, "err": err,
+                "dt": time.perf_counter() - t0}
+
+    # ---- leg 2: zero-downtime handoff over HTTP ---------------------
+    primary, ws2 = make_fleet(1)
+    standby, _ = make_fleet(1, ws=ws2, standby=True)
+    p1, p2 = free_port(), free_port()
+    url1, url2 = (f"http://127.0.0.1:{p1}", f"http://127.0.0.1:{p2}")
+    front1 = FleetServer(primary, port=p1, log_fn=lambda s: None)
+    front2 = FleetServer(standby, port=p2, log_fn=lambda s: None)
+    front1.start()
+    front2.start()
+    h_fail = h_409 = h_parity = 0
+    try:
+        ref = run_stream(url1, prompts[0], max_new)
+        if ref["err"] or ref["done"] is None:
+            raise RuntimeError(f"handoff reference failed: "
+                               f"{ref['err']}")
+        inflight = {}
+
+        def victim():
+            inflight["res"] = run_stream(url1, prompts[0], max_new)
+
+        vt = threading.Thread(target=victim)
+        vt.start()
+        time.sleep(0.3)              # mid-stream
+        st, got = http_json(url1 + "/admin/handoff",
+                            {"successor": url2, "retry_after": 0.2})
+        if st != 200 or not got.get("lame_duck"):
+            h_fail += 1
+        try:
+            http_json(url1 + "/generate", {"tokens": prompts[0]})
+            h_fail += 1              # should have been refused
+        except urllib.error.HTTPError as e:
+            body = json.loads(e.read())
+            if e.code == 409 and body.get("successor") == url2:
+                h_409 = 1
+        st, got = http_json(url2 + "/admin/promote", {})
+        if st != 200 or int(got.get("epoch", 0)) < 2:
+            h_fail += 1
+        vt.join(300.0)
+        res = inflight.get("res")
+        if res is None or res["err"] or res["done"] is None:
+            h_fail += 1              # in-flight must finish on the
+        elif res["toks"] != ref["toks"]:   # lame duck
+            h_parity += 1
+        after = run_stream(url2, prompts[0], max_new)
+        if after["err"] or after["done"] is None:
+            h_fail += 1
+        elif after["toks"] != ref["toks"]:
+            h_parity += 1
+        handoff_epoch = int(standby.epoch)
+    finally:
+        front1.stop()
+        front2.stop()
+        standby.stop()
+        primary.stop()
+
+    # ---- leg 3: control state survives a rebuild --------------------
+    f1, ws3 = make_fleet(2, quarantine_after=2, probe_period_s=0.05,
+                         readmit_base_s=30.0, state_snapshot_s=0.05)
+    victim_name = f1.router.names()[-1]
+    f1.router.handle_for(victim_name).kill()
+    deadline = time.time() + 30.0
+    while time.time() < deadline:
+        if any(m["name"] == victim_name and m["quarantined"]
+               for m in f1.router.members()):
+            break
+        time.sleep(0.02)
+    f1.router._shed_backoffs.shed_delay("interactive", tenant="acme")
+    f1.router._shed_backoffs.shed_delay("interactive", tenant="acme")
+    time.sleep(0.3)                  # >= several snapshot periods
+    f1.stop()
+    f2, _ = make_fleet(2, ws=ws3, quarantine_after=2,
+                       probe_period_s=0.05, readmit_base_s=30.0,
+                       state_snapshot_s=0.05)
+    m2 = {m["name"]: m for m in f2.router.members()}
+    s_quarantine = int(m2[victim_name]["quarantined"])
+    s_streak = int(f2.router._shed_backoffs.export_streaks()
+                   .get("acme\tinteractive", 0) == 2)
+    f2.stop()
+
+    # ---- leg 4: WAL overhead A/B ------------------------------------
+    fleet_on, _ = make_fleet(1)
+    fleet_off, _ = make_fleet(1, wal="off")
+    po, pf = free_port(), free_port()
+    fr_on = FleetServer(fleet_on, port=po, log_fn=lambda s: None)
+    fr_off = FleetServer(fleet_off, port=pf, log_fn=lambda s: None)
+    fr_on.start()
+    fr_off.start()
+    try:
+        uo, uf = f"http://127.0.0.1:{po}", f"http://127.0.0.1:{pf}"
+        run_stream(uo, prompts[0], max_new)      # warm both paths
+        run_stream(uf, prompts[0], max_new)
+        rates = {"on": [], "off": []}
+        for _ in range(5):                       # interleaved A/B
+            for key, u in (("on", uo), ("off", uf)):
+                r = run_stream(u, prompts[0], max_new)
+                if r["err"]:
+                    raise RuntimeError(f"overhead leg stream failed "
+                                       f"(wal={key}): {r['err']}")
+                rates[key].append(max_new / r["dt"])
+        p50_on = float(np.median(rates["on"]))
+        p50_off = float(np.median(rates["off"]))
+        overhead_ratio = p50_on / p50_off
+    finally:
+        fr_on.stop()
+        fr_off.stop()
+        fleet_on.stop()
+        fleet_off.stop()
+
+    # ---- leg 5: WAL write fault degrades to counted loss ------------
+    with inject(FaultSchedule.parse("router.wal@0:error")):
+        ff, _ = make_fleet(1)
+        done = None
+        for ev in ff.generate_stream(prompts[0], max_new=64,
+                                     timeout=120.0):
+            if ev.get("done"):
+                done = ev
+        ff.wal.flush()
+        lost = int(ff.wal_stats.snapshot()["wal_lost"])
+        fault_ok = int(done is not None and not done.get("error")
+                       and len(done.get("tokens") or []) == 64)
+        ff.stop()
+
+    gates = {
+        "restart_stream_failures": {
+            "value": r_fail, "bound": 0, "op": "==",
+            "pass": bool(r_fail == 0)},
+        "restart_dup_tokens": {
+            "value": r_dup, "bound": 0, "op": "==",
+            "pass": bool(r_dup == 0)},
+        "restart_missing_tokens": {
+            "value": r_missing, "bound": 0, "op": "==",
+            "pass": bool(r_missing == 0)},
+        "restart_parity_mismatch": {
+            "value": r_parity, "bound": 0, "op": "==",
+            "pass": bool(r_parity == 0)},
+        "restart_recovered_streams": {
+            "value": r_recovered, "bound": 3, "op": ">=",
+            "pass": bool(r_recovered >= 3)},
+        "handoff_client_failures": {
+            "value": h_fail, "bound": 0, "op": "==",
+            "pass": bool(h_fail == 0)},
+        "handoff_refusal_points_successor": {
+            "value": h_409, "bound": 1, "op": "==",
+            "pass": bool(h_409 == 1)},
+        "handoff_parity_mismatch": {
+            "value": h_parity, "bound": 0, "op": "==",
+            "pass": bool(h_parity == 0)},
+        "state_quarantine_survived": {
+            "value": s_quarantine, "bound": 1, "op": "==",
+            "pass": bool(s_quarantine == 1)},
+        "state_shed_streak_survived": {
+            "value": s_streak, "bound": 1, "op": "==",
+            "pass": bool(s_streak == 1)},
+        "wal_overhead_ratio": {
+            "value": round(overhead_ratio, 4), "bound": 0.97,
+            "op": ">=", "pass": bool(overhead_ratio >= 0.97)},
+        "wal_fault_counted_loss": {
+            "value": lost, "bound": 1, "op": ">=",
+            "pass": bool(lost >= 1 and fault_ok == 1)},
+    }
+    failures = [f"{k}: {g['value']} not {g['op']} {g['bound']}"
+                for k, g in gates.items() if not g["pass"]]
+    if failures:
+        raise RuntimeError("router smoke FAILED: "
+                           + "; ".join(failures))
+
+    result = {
+        "metric": "router_crash_safe_streams",
+        "value": r_recovered,
+        "unit": "streams",
+        "stream_tokens": max_new,
+        "restart_leg": {"failures": r_fail, "dup": r_dup,
+                        "missing": r_missing,
+                        "parity_mismatch": r_parity,
+                        "recovered": r_recovered,
+                        "epoch_after_restart": restart_epoch},
+        "handoff_leg": {"failures": h_fail,
+                        "refusal_points_successor": h_409,
+                        "parity_mismatch": h_parity,
+                        "promoted_epoch": handoff_epoch},
+        "state_leg": {"quarantine_survived": s_quarantine,
+                      "shed_streak_survived": s_streak},
+        "overhead_leg": {"p50_tok_s_wal_on": round(p50_on, 1),
+                         "p50_tok_s_wal_off": round(p50_off, 1),
+                         "ratio": round(overhead_ratio, 4)},
+        "wal_fault_leg": {"wal_lost": lost, "stream_ok": fault_ok},
+        "gates": gates,
+        "backend": jax.default_backend(),
+    }
+    line = json.dumps(result)
+    if out:
+        with open(out, "w") as f:
+            f.write(line + "\n")
+    return result
+
+
 def bench_trace_smoke(out=None):
     """ISSUE 14 acceptance (docs/OBSERVABILITY.md): fleet-wide
     distributed tracing.  Three legs:
@@ -2591,6 +3060,12 @@ def main() -> None:
         if "--out" in sys.argv:
             out = sys.argv[sys.argv.index("--out") + 1]
         print(json.dumps(bench_failover_smoke(out=out)))
+        return
+    if "--router-smoke" in sys.argv:
+        out = None
+        if "--out" in sys.argv:
+            out = sys.argv[sys.argv.index("--out") + 1]
+        print(json.dumps(bench_router_smoke(out=out)))
         return
     if "--trace-smoke" in sys.argv:
         out = None

@@ -235,6 +235,15 @@ def make_serve_argparser() -> argparse.ArgumentParser:
                     help="run this engine as a fleet member: never "
                          "self-reload; only the rollout controller's "
                          "POST /admin/reload moves the served params")
+    ap.add_argument("--standby", action="store_true",
+                    help="start the fleet router as a warm STANDBY "
+                         "over the same --workspace as the primary: "
+                         "engines load and warm but the data plane "
+                         "stays 503 until POST /admin/promote claims "
+                         "the next epoch, fences the primary's "
+                         "session WAL, and replays it "
+                         "(docs/SERVING.md, control-plane "
+                         "durability; needs --fleet/--fleet_hostfile)")
     ap.add_argument("--fault_spec", default=None,
                     help="deterministic fault injection over the "
                          "serve.* and fleet.* sites "
@@ -253,6 +262,10 @@ def serve_main(argv) -> int:
         print("error: --fleet and --fleet_hostfile are mutually "
               "exclusive (spawn a fleet OR adopt one)",
               file=sys.stderr)
+        return 2
+    if args.standby and not (args.fleet or args.fleet_hostfile):
+        print("error: --standby is a fleet-router mode (needs "
+              "--fleet or --fleet_hostfile)", file=sys.stderr)
         return 2
     from .utils.faults import FaultSchedule, inject
     schedule = (FaultSchedule.parse(args.fault_spec, seed=args.seed)
@@ -363,20 +376,28 @@ def _fleet_main(args, net, spec, fallback, schedule, log) -> int:
             fleet = EngineFleet.from_hostfile(
                 args.fleet_hostfile, workspace=args.workspace,
                 router_spec=router_spec, rollout_spec=rollout_spec,
-                tenancy=tenancy, log_fn=log)
+                tenancy=tenancy, standby=args.standby, log_fn=log)
         else:
             fleet = EngineFleet.local(
                 net, spec, args.fleet, workspace=args.workspace,
                 params=fallback, router_spec=router_spec,
                 rollout_spec=rollout_spec, tenancy=tenancy,
-                log_fn=log)
+                standby=args.standby, log_fn=log)
         scaler = None
-        if autoscale_spec is not None:
+        if autoscale_spec is not None and args.standby:
+            log("warning: --autoscale_spec ignored on a standby "
+                "router (no traffic signal to scale on until "
+                "promote)")
+        elif autoscale_spec is not None:
             if not fleet.can_grow():
                 log("warning: --autoscale_spec on an adopted "
                     "(hostfile) fleet can only scale DOWN — spawning "
                     "remote workers is deployment's job")
             scaler = AutoScaler(fleet, spec=autoscale_spec, log_fn=log)
+            # cooldown/streak survive a router restart: without this a
+            # crash forgets the flap damping and can oscillate
+            fleet.add_state_provider("autoscale", scaler.export_state,
+                                     scaler.restore_state)
         reg = obs.registry()
         if reg is not None:
             fleet.router.stats.register_into(reg)

@@ -47,6 +47,10 @@ TRIGGER_KINDS = {
     # a compile landing in an already-warm scope is an anomaly worth a
     # post-mortem window (what request geometry broke the buckets?)
     "perf.recompile_anomaly": "recompile",
+    # a router came back from the dead and replayed its WAL: the
+    # recovery evidence (what was journaled, what resumed, what went
+    # stale) is exactly what the post-mortem of the crash needs
+    "router.recover": "router_restart",
 }
 
 #: `serve.shed` events inside the window that constitute a storm

@@ -37,6 +37,12 @@ checkpoints via atomic hot-reload.  Layers:
                  number, resume-as-prefill on a same-fingerprint
                  sibling, at-most-once splice, idle-watchdog and
                  drain-kick triggers, singa_stream_* counters
+    sessionlog.py  SessionWal + ControlStateStore: the crash-safe
+                 control plane — append-only per-epoch session WAL
+                 (group commit, CRC per record, torn-tail-tolerant
+                 replay), atomically-snapshotted control state,
+                 epoch claim/fence for restart and zero-downtime
+                 handoff, singa_router_wal_* counters
     fleet.py     EngineFleet + RolloutController + FleetServer:
                  N workers behind one router, canary rollout with
                  auto-rollback, streaming passthrough, elastic
@@ -78,12 +84,14 @@ from .engine import InferenceEngine, ServeSpec
 from .fleet import (EngineFleet, FleetServer, RolloutController,
                     RolloutSpec)
 from .kvcache import PagedKVCache
-from .router import (EngineUnavailable, HttpEngineHandle,
+from .router import (EngineUnavailable, HttpEngineHandle, LameDuck,
                      LocalEngineHandle, Router, RouterSpec,
-                     RouterStats)
+                     RouterStats, UnknownSession)
 from .scheduler import ContinuousScheduler, StreamTicket
 from .server import InferenceServer
 from .session import SessionManager, StreamSession, StreamStats
+from .sessionlog import (ControlStateStore, SessionWal, WalStats,
+                         replay_wal, reduce_sessions, walcheck)
 from .router import UnknownModel
 from .stats import ServeStats
 from .qos import PRIORITIES, ClassBackoffs, RetryBudget
@@ -92,14 +100,18 @@ from .traffic import (Phase, TrafficGen, diurnal, flash_crowd,
                       kill_chaos, ramp, stall_chaos, steady)
 
 __all__ = ["AutoScaler", "AutoScaleSpec", "Cancelled",
-           "ClassBackoffs", "ContinuousScheduler", "DeadlineExpired",
+           "ClassBackoffs", "ContinuousScheduler",
+           "ControlStateStore", "DeadlineExpired",
            "EngineFleet", "EngineUnavailable", "FleetServer",
            "HttpEngineHandle", "InferenceEngine", "InferenceServer",
-           "LocalEngineHandle", "MicroBatcher", "Overloaded",
-           "PRIORITIES", "PagedKVCache", "Phase", "RetryBudget",
-           "RolloutController", "RolloutSpec", "Router", "RouterSpec",
-           "RouterStats", "ServeSpec", "ServeStats", "SessionManager",
+           "LameDuck", "LocalEngineHandle", "MicroBatcher",
+           "Overloaded", "PRIORITIES", "PagedKVCache", "Phase",
+           "RetryBudget", "RolloutController", "RolloutSpec",
+           "Router", "RouterSpec", "RouterStats", "ServeSpec",
+           "ServeStats", "SessionManager", "SessionWal",
            "StreamSession", "StreamStats", "StreamTicket",
            "TenantBudget", "TenantRegistry", "TenantSpec", "Ticket",
-           "TrafficGen", "UnknownModel", "diurnal", "flash_crowd",
-           "kill_chaos", "qos", "ramp", "stall_chaos", "steady"]
+           "TrafficGen", "UnknownModel", "UnknownSession", "WalStats",
+           "diurnal", "flash_crowd", "kill_chaos", "qos", "ramp",
+           "reduce_sessions", "replay_wal", "stall_chaos", "steady",
+           "walcheck"]

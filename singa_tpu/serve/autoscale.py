@@ -455,6 +455,29 @@ class AutoScaler:
         t.start()
         return "down"
 
+    # -- durable control state (fleet state provider) ------------------------
+    def export_state(self) -> Dict[str, Any]:
+        """What a reborn autoscaler must remember: the cooldown still
+        in force (as remaining seconds — monotonic clocks don't
+        survive a restart) and the same-direction streak that sized
+        it.  Without this a crash-restart forgets the cooldown and
+        can oscillate immediately — the exact flap damping exists to
+        prevent."""
+        with self._lock:
+            rem = max(self._cooldown_until - time.monotonic(), 0.0)
+            return {"cooldown_remaining_s": round(rem, 3),
+                    "streak": self._streak,
+                    "last_dir": self._last_dir}
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        with self._lock:
+            rem = float(state.get("cooldown_remaining_s", 0.0))
+            if rem > 0:
+                self._cooldown_until = time.monotonic() + rem
+            self._streak = max(int(state.get("streak", 0)), 0)
+            last = state.get("last_dir")
+            self._last_dir = str(last) if last is not None else None
+
     # -- reads --------------------------------------------------------------
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:

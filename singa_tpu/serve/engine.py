@@ -325,6 +325,18 @@ class InferenceEngine:
         # healthy — probes pass, requests complete — it is just SLOW,
         # which is exactly the failure mode hedging exists for.
         self.stall_s = 0.0
+        # reload-poll supervision (server._poll_loop): consecutive
+        # unexpected poll deaths — /healthz degrades once the streak
+        # crosses degraded_after, because an engine whose poller
+        # cannot stay alive is quietly going stale
+        self._poll_death_streak = 0
+
+    def note_poll_death(self) -> int:
+        self._poll_death_streak += 1
+        return self._poll_death_streak
+
+    def note_poll_ok(self) -> None:
+        self._poll_death_streak = 0
 
     # -- params lifecycle ---------------------------------------------------
     @property
@@ -561,6 +573,11 @@ class InferenceEngine:
                            f"(threshold {k})")
         if self._stale_reason is not None:
             reasons.append(self._stale_reason)
+        if self._poll_death_streak >= k:
+            reasons.append(
+                f"reload poll died {self._poll_death_streak} times "
+                f"in a row (threshold {k}); params may be going "
+                f"stale")
         return {"ok": not reasons,
                 "status": "ok" if not reasons else "degraded",
                 "step": self.params_step,

@@ -57,6 +57,7 @@ on a survivor.
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import time
 from dataclasses import dataclass
@@ -66,9 +67,12 @@ from .. import obs
 from ..utils import faults
 from ..utils.checkpoint import CheckpointManager
 from .engine import InferenceEngine, ServeSpec
-from .router import (LocalEngineHandle, Router, RouterSpec,
-                     HttpEngineHandle, _handle_call)
+from .router import (LameDuck, LocalEngineHandle, Router, RouterSpec,
+                     HttpEngineHandle, UnknownSession, _handle_call)
 from .server import InferenceServer
+from .sessionlog import (ControlStateStore, SessionWal, WalStats,
+                         claim_epoch, latest_wal_before, reduce_sessions,
+                         replay_wal)
 from .tenancy import TenantRegistry
 
 
@@ -482,6 +486,27 @@ class RolloutController:
                     "canary_aborts": self.canary_aborts,
                     "torn_polls": self.mgr.torn_polls}
 
+    # -- durable control state (sessionlog.ControlStateStore) ---------------
+    def export_state(self) -> Dict[str, Any]:
+        """The rollout decisions that must survive a router restart:
+        the pinned step (what the fleet serves) and the rejected
+        fingerprint (a judged-and-rolled-back checkpoint must not be
+        re-canaried by the reborn router)."""
+        with self._lock:
+            return {"pinned_step": self.pinned_step,
+                    "rejected_fp": (list(self._rejected_fp)
+                                    if self._rejected_fp is not None
+                                    else None)}
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        with self._lock:
+            pinned = state.get("pinned_step")
+            if pinned is not None and int(pinned) >= 0:
+                self.pinned_step = int(pinned)
+            fp = state.get("rejected_fp")
+            if fp is not None:
+                self._rejected_fp = tuple(fp)
+
 
 class EngineFleet:
     """N engine workers + router + rollout controller, owned together.
@@ -496,7 +521,7 @@ class EngineFleet:
                  router_spec: Optional[RouterSpec] = None,
                  rollout_spec: Optional[RolloutSpec] = None,
                  tenancy: Optional[TenantRegistry] = None,
-                 log_fn=print):
+                 standby: bool = False, log_fn=print):
         self.log = log_fn
         self.tenancy = tenancy if tenancy is not None \
             else TenantRegistry()
@@ -515,6 +540,205 @@ class EngineFleet:
         self._spawn_cfg: Optional[Dict[str, Any]] = None
         self._next_idx = len(handles)
         self._grow_lock = threading.Lock()
+        # -- crash-safe control plane (sessionlog.py) -------------------
+        # a standby holds OFF claiming an epoch: claiming fences the
+        # live primary's WAL, which is exactly the handoff and must
+        # only happen at promote_standby()
+        self.workspace = workspace
+        self.standby = bool(standby)
+        self.epoch = 0
+        self.wal: Optional[SessionWal] = None
+        self.wal_stats = WalStats()
+        self._state_store: Optional[ControlStateStore] = None
+        self.recovered_state: Dict[str, Any] = {}
+        # extra durable-state providers (autoscaler etc.): name ->
+        # (export_fn, restore_fn); restore happens at recover() time
+        # for providers registered before start(), else via
+        # `recovered_state`
+        self._state_providers: Dict[str, Any] = {}
+        self._snap_stop = threading.Event()
+        self._snap_thread: Optional[threading.Thread] = None
+        if not self.standby:
+            self._init_durability()
+
+    # -- crash-safe control plane -------------------------------------------
+    def _router_dir(self) -> Optional[str]:
+        if not self.workspace:
+            return None
+        return os.path.join(self.workspace, "router")
+
+    def _init_durability(self) -> None:
+        """Claim the next epoch and open this router's WAL.  Claiming
+        bumps `<ws>/router/EPOCH`, which self-fences any older router
+        still appending to the shared workspace (SessionWal.flush
+        re-reads the file) — restart and handoff share one mechanism."""
+        dir_ = self._router_dir()
+        if dir_ is None or self.router.spec.wal != "on":
+            return
+        try:
+            self.epoch = claim_epoch(dir_)
+            self.wal = SessionWal(
+                dir_, self.epoch,
+                group_tokens=self.router.spec.wal_group_tokens,
+                group_ms=self.router.spec.wal_group_ms,
+                stats=self.wal_stats, log_fn=self.log)
+            self._state_store = ControlStateStore(
+                dir_, stats=self.wal_stats)
+            self.router.attach_wal(self.wal, self.epoch)
+            self.log(f"fleet: session WAL on under epoch "
+                     f"{self.epoch} ({dir_})")
+        except Exception as e:  # noqa: BLE001 — durability is an
+            # add-on: a broken disk degrades to the pre-WAL fleet,
+            # counted, never a refusal to serve
+            self.wal_stats.count("wal_lost")
+            self.log(f"warning: could not open session WAL in "
+                     f"{dir_} ({type(e).__name__}: {e}); serving "
+                     f"without control-plane durability")
+            self.wal = None
+
+    def add_state_provider(self, name: str, export_fn,
+                           restore_fn=None) -> None:
+        """Register an extra durable-state contributor (e.g. the
+        autoscaler's cooldown/streak).  If recovery already ran, the
+        provider's slice is in `recovered_state` — restore it now."""
+        self._state_providers[name] = (export_fn, restore_fn)
+        got = self.recovered_state.get(name)
+        if got is not None and restore_fn is not None:
+            try:
+                restore_fn(got)
+            except Exception as e:  # noqa: BLE001
+                self.log(f"warning: restoring {name} state failed "
+                         f"({e}); starting fresh")
+
+    def export_control_state(self) -> Dict[str, Any]:
+        """Everything the next epoch needs that is NOT in the WAL:
+        quarantine strikes/benches, shed streaks, rollout pin +
+        rejected fingerprint, and any registered provider's slice."""
+        state: Dict[str, Any] = {"epoch": self.epoch,
+                                 "wall": round(time.time(), 3)}
+        state["router"] = self.router.export_control_state()
+        if self.rollout is not None:
+            state["rollout"] = self.rollout.export_state()
+        for name, (export_fn, _r) in self._state_providers.items():
+            try:
+                state[name] = export_fn()
+            except Exception:  # noqa: BLE001 — a provider's failure
+                pass           # must not sink the whole snapshot
+        return state
+
+    def _snapshot_loop(self) -> None:
+        period = float(self.router.spec.state_snapshot_s)
+        while not self._snap_stop.wait(period):
+            if self._state_store is not None:
+                self._state_store.save(self.export_control_state())
+
+    def recover(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Replay the previous epoch's control snapshot and session
+        WAL: restore quarantine/rollout/shed-streak state, then
+        re-admit every non-terminal journaled stream through the
+        durable-session resume path (pinned to the journaled
+        fingerprint).  Clients reconnect with X-Session-Id and splice
+        exactly-once; a fingerprint-gone stream finishes
+        `failover_stale` with the journaled prefix."""
+        summary = {"epoch": self.epoch, "state_restored": False,
+                   "wal_replayed": None, "torn_tail": False,
+                   "sessions": 0, "terminal": 0, "recovered": 0,
+                   "failed": 0}
+        dir_ = self._router_dir()
+        if dir_ is None or self.wal is None:
+            return summary
+        try:
+            faults.maybe_fault("router.recover")
+            if self._state_store is not None:
+                state = self._state_store.load()
+                if state is not None:
+                    self.router.restore_control_state(
+                        state.get("router") or {})
+                    if self.rollout is not None and \
+                            state.get("rollout"):
+                        self.rollout.restore_state(state["rollout"])
+                    self.recovered_state = state
+                    for name, (_e, restore_fn) in \
+                            self._state_providers.items():
+                        if restore_fn is not None and \
+                                state.get(name) is not None:
+                            restore_fn(state[name])
+                    summary["state_restored"] = True
+            prev = latest_wal_before(dir_, self.epoch)
+            if prev is not None:
+                header, records, torn = replay_wal(prev)
+                if torn:
+                    self.wal_stats.count("torn_tails")
+                reduced = reduce_sessions(records)
+                for _ in reduced:
+                    self.wal_stats.count("replayed_sessions")
+                got = self.router.recover_sessions(reduced,
+                                                   timeout=timeout)
+                for _ in range(int(got.get("recovered", 0))):
+                    self.wal_stats.count("recovered_streams")
+                summary.update(
+                    wal_replayed=os.path.basename(prev),
+                    torn_tail=bool(torn), sessions=len(reduced),
+                    **{k: int(got.get(k, 0))
+                       for k in ("terminal", "recovered", "failed")})
+        except Exception as e:  # noqa: BLE001 — a broken replay must
+            # never stop the fleet from serving NEW traffic
+            self.log(f"warning: control-plane recovery failed "
+                     f"({type(e).__name__}: {e}); serving without "
+                     f"replayed state")
+            summary["error"] = f"{type(e).__name__}: {e}"
+        if summary["wal_replayed"] or summary["state_restored"]:
+            self.log(f"fleet: recovered control plane under epoch "
+                     f"{self.epoch}: {summary['recovered']} stream(s) "
+                     f"re-admitted, {summary['terminal']} terminal "
+                     f"session(s) retained"
+                     + (", torn WAL tail dropped"
+                        if summary["torn_tail"] else ""))
+        obs.emit_event("router.recover", **{
+            k: v for k, v in summary.items() if v is not None})
+        return summary
+
+    def handoff(self, successor: Optional[str] = None,
+                retry_after: float = 0.5) -> Dict[str, Any]:
+        """Lame-duck this router for a zero-downtime handoff: stop
+        admitting (409 + successor hint), snapshot control state,
+        flush and fence the WAL.  In-flight streams keep running and
+        journaled attach/resume stays served; the successor claims
+        the next epoch and replays what this router leaves behind."""
+        self.router.enter_lame_duck(successor=successor,
+                                    retry_after=retry_after)
+        if self._state_store is not None:
+            self._state_store.save(self.export_control_state())
+        if self.wal is not None:
+            self.wal.fence()
+        self.log(f"fleet: handoff initiated (epoch {self.epoch}"
+                 + (f", successor {successor}" if successor else "")
+                 + "); WAL fenced, new admissions get 409")
+        out = {"epoch": self.epoch, "successor": successor,
+               "lame_duck": True}
+        obs.emit_event("router.handoff", **out)
+        return out
+
+    def promote_standby(self,
+                        timeout: Optional[float] = None
+                        ) -> Dict[str, Any]:
+        """Turn a standby into the primary: claim the next epoch
+        (fencing the old primary's WAL), replay its state + WAL, and
+        open this fleet for admissions."""
+        if not self.standby:
+            raise RuntimeError("fleet is not a standby")
+        self.standby = False
+        self._init_durability()
+        got = self.recover(timeout=timeout)
+        if self._snap_thread is None and self._state_store is not None:
+            self._snap_stop.clear()
+            self._snap_thread = threading.Thread(
+                target=self._snapshot_loop, name="fleet-state-snap",
+                daemon=True)
+            self._snap_thread.start()
+        self.log(f"fleet: standby promoted to primary under epoch "
+                 f"{self.epoch}")
+        return got
 
     # -- constructors -------------------------------------------------------
     @classmethod
@@ -523,7 +747,7 @@ class EngineFleet:
               router_spec: Optional[RouterSpec] = None,
               rollout_spec: Optional[RolloutSpec] = None,
               tenancy: Optional[TenantRegistry] = None,
-              warmup_modes=("generate",),
+              warmup_modes=("generate",), standby: bool = False,
               log_fn=print) -> "EngineFleet":
         """Spawn `size` in-process engine workers (each its own
         pinned engine, batcher, and stats) over one shared net.  The
@@ -548,7 +772,7 @@ class EngineFleet:
         fleet = cls(handles, workspace=workspace,
                     router_spec=router_spec,
                     rollout_spec=rollout_spec, tenancy=tenancy,
-                    log_fn=log_fn)
+                    standby=standby, log_fn=log_fn)
         fleet._spawn_cfg = dict(net=net, spec=spec,
                                 workspace=workspace, params=params,
                                 tenancy=tenancy,
@@ -561,13 +785,13 @@ class EngineFleet:
               router_spec: Optional[RouterSpec] = None,
               rollout_spec: Optional[RolloutSpec] = None,
               tenancy: Optional[TenantRegistry] = None,
-              log_fn=print) -> "EngineFleet":
+              standby: bool = False, log_fn=print) -> "EngineFleet":
         """Adopt already-running engine processes by base URL."""
         handles = [HttpEngineHandle(f"engine-{i}", u)
                    for i, u in enumerate(urls)]
         return cls(handles, workspace=workspace,
                    router_spec=router_spec, rollout_spec=rollout_spec,
-                   tenancy=tenancy, log_fn=log_fn)
+                   tenancy=tenancy, standby=standby, log_fn=log_fn)
 
     @classmethod
     def from_hostfile(cls, path: str, default_port: int = 8000,
@@ -586,23 +810,47 @@ class EngineFleet:
         for h in self._local:
             h.start()
         self.router.start()
+        # restore + replay BEFORE the rollout controller pins: a
+        # restored pinned step must win over the members' cold-start
+        # step, and recovered streams need engines adopted first
+        if not self.standby:
+            self.recover()
         if self.rollout is not None:
-            # pin the fleet at the step the members actually serve
+            # pin the fleet at the step the members actually serve —
+            # unless recovery restored a promoted pin (restore_state
+            # already set it; keep the max so a newer promotion that
+            # members still serve is not walked back)
             steps = [self.router.engine_step(n)
                      for n in self.router.names()]
-            self.rollout.start(max(steps) if steps else -1)
+            pin = max(steps) if steps else -1
+            self.rollout.start(max(pin, self.rollout.pinned_step))
+        if not self.standby and self._state_store is not None and \
+                self._snap_thread is None:
+            self._snap_stop.clear()
+            self._snap_thread = threading.Thread(
+                target=self._snapshot_loop, name="fleet-state-snap",
+                daemon=True)
+            self._snap_thread.start()
         n_ok = len(self.router.healthy_names())
         self.log(f"fleet: {n_ok}/{len(self.router.names())} engine(s) "
                  f"healthy"
                  + (f", rollout pinned at step "
                     f"{self.rollout.pinned_step}"
-                    if self.rollout is not None else ""))
+                    if self.rollout is not None else "")
+                 + (" [STANDBY: admissions closed until promote]"
+                    if self.standby else ""))
         return self
 
     def stop(self) -> None:
+        self._snap_stop.set()
+        if self._snap_thread is not None:
+            self._snap_thread.join(5.0)
+            self._snap_thread = None
         if self.rollout is not None:
             self.rollout.stop()
         self.router.stop()
+        if self.wal is not None:
+            self.wal.close()
         for h in self._local:
             if h._alive:
                 h.stop()
@@ -703,6 +951,9 @@ class EngineFleet:
         out = self.router.snapshot()
         if self.rollout is not None:
             out["rollout"] = self.rollout.snapshot()
+        out["standby"] = self.standby
+        if self.wal is not None or self.standby:
+            out["wal"] = self.wal_stats.snapshot()
         return out
 
 
@@ -731,6 +982,9 @@ class FleetServer:
         # durable-stream session counters (singa_stream_*): failover /
         # splice / dedupe visibility next to the fleet counters
         self.fleet.router.sessions.stats.register_into(self.metrics)
+        # control-plane durability (singa_router_wal_*): appends,
+        # bytes, lost writes, fenced writes, replay/recovery counts
+        self.fleet.wal_stats.register_into(self.metrics)
         self._host, self._port = host, port
         self._httpd = None
         self._http_thread: Optional[threading.Thread] = None
@@ -760,6 +1014,9 @@ class FleetServer:
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                if fleet.epoch:
+                    self.send_header(_qos.EPOCH_HEADER,
+                                     str(fleet.epoch))
                 for k, v in (headers or {}).items():
                     self.send_header(k, v)
                 self.end_headers()
@@ -786,13 +1043,29 @@ class FleetServer:
                                      str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif self.path == "/control/state":
+                    # the durable control snapshot, live — what a
+                    # successor (or an operator) would recover from
+                    self._reply(200, fleet.export_control_state())
                 elif self.path == "/healthz":
                     healthy = len(fleet.router.healthy_names())
                     total = len(fleet.router.names())
+                    if fleet.standby:
+                        # a standby is HEALTHY-but-not-serving: load
+                        # balancers must not route to it, operators
+                        # must see it alive and promotable
+                        self._reply(200, {
+                            "ok": True, "status": "standby",
+                            "healthy_engines": healthy,
+                            "engines": total})
+                        return
                     ok = healthy > 0
+                    status = "ok" if ok else "degraded"
+                    if ok and fleet.router.lame_duck is not None:
+                        status = "lame_duck"
                     self._reply(200 if ok else 503, {
                         "ok": ok,
-                        "status": "ok" if ok else "degraded",
+                        "status": status,
                         "healthy_engines": healthy,
                         "engines": total})
                 else:
@@ -811,43 +1084,60 @@ class FleetServer:
                     self.headers.get(_qos.TRACE_HEADER),
                     self.headers.get(_qos.PARENT_SPAN_HEADER))
 
-            def _stream(self, tokens, req):
+            def _stream(self, req):
                 """Chunked passthrough: re-serialize the engine's
                 token events as they arrive — the full body is never
                 buffered at the fleet tier.  route_stream raises
                 BEFORE the 200 when no engine admits the stream, so
                 admission errors keep their status codes; a
                 mid-stream failure becomes a terminal {"error": ...}
-                line."""
-                mn = req.get("max_new")
-                link = self._remote_trace()
-                # degrade-never-reject: garbled tenant folds to
-                # "default" (qos.check_tenant cannot raise)
-                tenant = _qos.check_tenant(
-                    req.get("tenant")
-                    or self.headers.get(_qos.TENANT_HEADER))
-                # the span covers ADMISSION only (route_stream admits
-                # eagerly and returns the generator) — the router's
-                # stream spans anchor to it via the thread-local; a
-                # span must never stay open across generator yields
-                with obs.span("fleet.request", mode="stream",
-                              tenant=tenant,
-                              trace=link[0] if link else None,
-                              parent=((link[1] or None)
-                                      if link else None)):
-                    stream = fleet.router.route_stream(
-                        tokens, timeout=req.get("timeout"),
-                        max_new=None if mn is None else int(mn),
-                        deadline=_qos.deadline_from_header(
-                            self.headers.get(_qos.DEADLINE_HEADER)),
-                        priority=_qos.check_priority(
-                            req.get("priority")
-                            or self.headers.get(_qos.PRIORITY_HEADER)),
-                        tenant=tenant, model=req.get("model"))
+                line.  A `session`/X-Session-Id reconnect ATTACHES to
+                the journaled stream instead of admitting a new one —
+                the restart/handoff resume path, deliberately served
+                even while lame-ducked."""
+                sid = req.get("session") or \
+                    self.headers.get(_qos.SESSION_HEADER)
+                if sid:
+                    stream = fleet.router.attach_stream(
+                        str(sid),
+                        resume_from=int(req.get("resume_from", 0)))
+                else:
+                    tokens = np.asarray(req["tokens"], np.int32)
+                    mn = req.get("max_new")
+                    link = self._remote_trace()
+                    # degrade-never-reject: garbled tenant folds to
+                    # "default" (qos.check_tenant cannot raise)
+                    tenant = _qos.check_tenant(
+                        req.get("tenant")
+                        or self.headers.get(_qos.TENANT_HEADER))
+                    # the span covers ADMISSION only (route_stream
+                    # admits eagerly and returns the generator) — the
+                    # router's stream spans anchor to it via the
+                    # thread-local; a span must never stay open across
+                    # generator yields
+                    with obs.span("fleet.request", mode="stream",
+                                  tenant=tenant,
+                                  trace=link[0] if link else None,
+                                  parent=((link[1] or None)
+                                          if link else None)):
+                        stream = fleet.router.route_stream(
+                            tokens, timeout=req.get("timeout"),
+                            max_new=None if mn is None else int(mn),
+                            deadline=_qos.deadline_from_header(
+                                self.headers.get(
+                                    _qos.DEADLINE_HEADER)),
+                            priority=_qos.check_priority(
+                                req.get("priority")
+                                or self.headers.get(
+                                    _qos.PRIORITY_HEADER)),
+                            tenant=tenant, model=req.get("model"))
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "application/x-ndjson")
                 self.send_header("Transfer-Encoding", "chunked")
+                if fleet.epoch:
+                    self.send_header(_qos.EPOCH_HEADER,
+                                     str(fleet.epoch))
                 self.end_headers()
                 try:
                     for ev in stream:
@@ -860,18 +1150,34 @@ class FleetServer:
                 self._chunk(b"")
 
             def do_POST(self):
+                if self.path == "/admin/handoff":
+                    self._admin_handoff()
+                    return
+                if self.path == "/admin/promote":
+                    self._admin_promote()
+                    return
                 mode = self.path.lstrip("/")
                 if mode not in ("generate", "predict"):
                     self._reply(404,
                                 {"error": f"no route {self.path}"})
                     return
+                if fleet.standby:
+                    # the standby's data plane is closed until it is
+                    # promoted: routing here would split-brain the
+                    # session journal across two unfenced writers
+                    self._reply(503, {
+                        "error": "standby router: promote before "
+                                 "sending traffic",
+                        "status": "standby"},
+                        {"Retry-After": "1.0"})
+                    return
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(n) or b"{}")
-                    tokens = np.asarray(req["tokens"], np.int32)
                     if mode == "generate" and req.get("stream"):
-                        self._stream(tokens, req)
+                        self._stream(req)
                         return
+                    tokens = np.asarray(req["tokens"], np.int32)
                     link = self._remote_trace()
                     tenant = _qos.check_tenant(
                         req.get("tenant")
@@ -897,6 +1203,20 @@ class FleetServer:
                     # honest fast 404: the fleet does not serve this
                     # model family — never a shed, never a strike
                     self._reply(404, {"error": str(e)})
+                except LameDuck as e:
+                    # handing off: 409 points the client at the
+                    # successor — before KeyError/RuntimeError arms
+                    # (LameDuck IS a RuntimeError)
+                    self._reply(409, {"error": str(e),
+                                      "successor": e.successor,
+                                      "retry_after": e.retry_after},
+                                {"Retry-After":
+                                 f"{e.retry_after:.3f}"})
+                except UnknownSession as e:
+                    # 410 Gone, not 404: the sid grammar was right but
+                    # the journaled session is finished-and-evicted or
+                    # never existed — retrying cannot help
+                    self._reply(410, {"error": str(e)})
                 except _OL as e:
                     self._reply(503, {"error": str(e),
                                       "retry_after": e.retry_after},
@@ -907,6 +1227,38 @@ class FleetServer:
                 except (KeyError, ValueError,
                         json.JSONDecodeError) as e:
                     self._reply(400, {"error": f"bad request: {e}"})
+                except Exception as e:  # noqa: BLE001
+                    self._reply(500, {"error":
+                                      f"{type(e).__name__}: {e}"})
+
+            def _admin_handoff(self):
+                """Lame-duck this router for a zero-downtime handoff
+                (EngineFleet.handoff): body {"successor": url?,
+                "retry_after": s?}."""
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    out = fleet.handoff(
+                        successor=req.get("successor"),
+                        retry_after=float(req.get("retry_after",
+                                                  0.5)))
+                    self._reply(200, out)
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._reply(400, {"error": f"bad request: {e}"})
+                except Exception as e:  # noqa: BLE001
+                    self._reply(500, {"error":
+                                      f"{type(e).__name__}: {e}"})
+
+            def _admin_promote(self):
+                """Promote a standby to primary: claim the next
+                epoch (fencing the old primary) and replay its WAL."""
+                try:
+                    got = fleet.promote_standby()
+                    self._reply(200, got)
+                except RuntimeError as e:
+                    # not a standby: promoting a live primary would
+                    # fence ITS OWN WAL out from under it
+                    self._reply(409, {"error": str(e)})
                 except Exception as e:  # noqa: BLE001
                     self._reply(500, {"error":
                                       f"{type(e).__name__}: {e}"})

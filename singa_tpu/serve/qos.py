@@ -60,6 +60,18 @@ TENANT_HEADER = "X-Tenant"
 TRACE_HEADER = "X-Trace-Id"
 PARENT_SPAN_HEADER = "X-Parent-Span"
 
+#: Durable stream identity (serve/sessionlog.py): the sid is minted
+#: at stream open, returned in the FIRST ndjson event (and this
+#: response header), and presented back by a reconnecting client to
+#: attach to the journaled continuation exactly-once after a router
+#: crash or handoff
+SESSION_HEADER = "X-Session-Id"
+
+#: The serving router's fencing epoch, echoed on every response: a
+#: client (or standby) seeing the epoch move knows a
+#: restart/handoff happened even before any stream breaks
+EPOCH_HEADER = "X-Router-Epoch"
+
 #: Retry-After escalation factor per class: lower classes are told to
 #: stay away longer, so honest hints do the brownout's first pass
 _CLASS_FACTORS = (("interactive", 1.0), ("batch", 2.0),
@@ -293,3 +305,24 @@ class ClassBackoffs:
         with self._lock:
             key = self._ensure(tenant, priority)
             return self._streaks[key]
+
+    def export_streaks(self) -> dict:
+        """Nonzero streaks as a JSON-safe dict (control-state
+        snapshot): a tenant mid-escalation must NOT get a fresh
+        Retry-After ladder just because the router restarted."""
+        with self._lock:
+            return {f"{t}\t{p}": s
+                    for (t, p), s in self._streaks.items() if s}
+
+    def restore_streaks(self, streaks: dict) -> None:
+        with self._lock:
+            for key, s in (streaks or {}).items():
+                tenant, _, priority = str(key).partition("\t")
+                try:
+                    n = max(int(s), 0)
+                except (TypeError, ValueError):
+                    continue
+                if not priority:
+                    continue
+                k = self._ensure(tenant, priority)
+                self._streaks[k] = n

@@ -90,6 +90,25 @@ class _FailoverStale(RuntimeError):
     `finish: "failover_stale"` instead of splicing a lie."""
 
 
+class LameDuck(RuntimeError):
+    """The router is draining for handoff: in-flight streams finish,
+    NEW admissions are refused with a Retry-After pointing at the
+    successor (the HTTP layer's 409).  Not a shed — capacity exists,
+    it just lives behind the successor's address now."""
+
+    def __init__(self, msg: str, successor: Optional[str] = None,
+                 retry_after: float = 0.5):
+        super().__init__(msg)
+        self.successor = successor
+        self.retry_after = float(retry_after)
+
+
+class UnknownSession(KeyError):
+    """A reconnect presented a session id the router does not hold —
+    never journaled, or already evicted past the retention TTL/cap.
+    The HTTP layer's 410: retrying the SAME sid cannot succeed."""
+
+
 @dataclass(frozen=True)
 class RouterSpec:
     """Router config grammar (`--fleet_spec`, the ServeSpec mold):
@@ -115,6 +134,13 @@ class RouterSpec:
                                    # token for this long -> failover
                                    # (0 = off; catches engine.stall-
                                    # style silent stragglers)
+    wal: str = "on"                # durable session WAL (off = the
+                                   # pre-PR in-memory-only journal)
+    wal_group_tokens: int = 64     # group-commit: fsync every N
+    wal_group_ms: float = 25.0     # journaled records / T ms
+    state_snapshot_s: float = 0.5  # control-state snapshot cadence
+    session_ttl_s: float = 300.0   # terminal-session retention TTL
+    session_cap: int = 1024        # ... and count cap
 
     def __post_init__(self):
         if int(self.quarantine_after) < 1:
@@ -138,6 +164,17 @@ class RouterSpec:
         if float(self.stream_idle_s) < 0:
             raise ValueError(f"stream_idle_s must be >= 0, got "
                              f"{self.stream_idle_s}")
+        if str(self.wal) not in ("on", "off"):
+            raise ValueError(f"wal must be on|off, got {self.wal!r}")
+        if int(self.wal_group_tokens) < 1:
+            raise ValueError(f"wal_group_tokens must be >= 1, got "
+                             f"{self.wal_group_tokens}")
+        if float(self.wal_group_ms) < 0 or \
+                float(self.state_snapshot_s) <= 0:
+            raise ValueError("wal_group_ms must be >= 0 and "
+                             "state_snapshot_s > 0")
+        if float(self.session_ttl_s) < 0 or int(self.session_cap) < 0:
+            raise ValueError("session_ttl_s/session_cap must be >= 0")
 
     @classmethod
     def parse(cls, spec: Optional[str]) -> "RouterSpec":
@@ -332,11 +369,17 @@ class HttpEngineHandle:
                     req, timeout=timeout or self.connect_timeout_s) as r:
                 return json.loads(r.read())
         except urllib.error.HTTPError as e:
+            # drain + close the error body deterministically: under
+            # retry/hedge churn, leaving it to GC leaks one fd per
+            # failed call until collection runs (the fd-flat
+            # regression test in test_router_wal.py watches this)
             body = {}
             try:
                 body = json.loads(e.read())
             except Exception:  # noqa: BLE001 — non-JSON error body
                 pass
+            finally:
+                e.close()
             if e.code == 503 and path == "/healthz":
                 return body or {"ok": False, "status": "degraded"}
             if e.code == 503:
@@ -440,11 +483,15 @@ class HttpEngineHandle:
         try:
             resp = urllib.request.urlopen(req, timeout=budget)
         except urllib.error.HTTPError as e:
+            # same fd discipline as _call: the error response is a
+            # socket — close it before mapping the status
             body = {}
             try:
                 body = json.loads(e.read())
             except Exception:  # noqa: BLE001 — non-JSON error body
                 pass
+            finally:
+                e.close()
             if e.code == 503:
                 raise Overloaded(
                     body.get("error", "overloaded"),
@@ -462,21 +509,26 @@ class HttpEngineHandle:
 
         def gen():
             try:
-                with resp:
-                    for line in resp:
-                        line = line.strip()
-                        if not line:
-                            continue
-                        ev = json.loads(line)
-                        if "error" in ev and "done" not in ev:
-                            raise RuntimeError(
-                                f"engine {self.name} stream failed: "
-                                f"{ev['error']}")
-                        yield ev
+                for line in resp:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    ev = json.loads(line)
+                    if "error" in ev and "done" not in ev:
+                        raise RuntimeError(
+                            f"engine {self.name} stream failed: "
+                            f"{ev['error']}")
+                    yield ev
             except (urllib.error.URLError, ConnectionError,
                     OSError) as e:
                 raise RuntimeError(
                     f"engine {self.name} stream broken: {e}") from e
+            finally:
+                # unconditional teardown: a hedge loser's gen.close()
+                # or a failover abandon lands here via GeneratorExit,
+                # and the socket dies WITH the generator — never
+                # parked on the GC under churn
+                resp.close()
         return gen()
 
     def reload(self, step: Optional[int] = None,
@@ -522,7 +574,7 @@ class RouterStats:
               "attempts", "hedges", "hedge_wins", "deadline_terminal",
               "expired_on_arrival", "budget_denied", "brownout_sheds",
               "shed_interactive", "shed_batch", "shed_best_effort",
-              "unknown_model")
+              "unknown_model", "lame_duck_refusals")
 
     #: per-request lifecycle stages the router can time (the stage
     #: taxonomy in docs/OBSERVABILITY.md); each gets its own
@@ -833,6 +885,15 @@ class Router:
         # durable stream sessions: the journal mid-stream failover
         # resumes from (serve/session.py)
         self.sessions = SessionManager()
+        # crash-safe control plane (serve/sessionlog.py): the fleet
+        # wires a SessionWal + epoch in via attach_wal before traffic;
+        # epoch 0 = no durability (the pre-PR in-memory-only shape)
+        self.wal = None
+        self.epoch = 0
+        # lame-duck drain for zero-downtime handoff: non-None refuses
+        # NEW admissions (LameDuck -> HTTP 409 + Retry-After at the
+        # successor) while in-flight streams finish
+        self.lame_duck: Optional[Dict[str, Any]] = None
         # per-request lifecycle records (GET /debug/requests)
         self.requests = RequestLog()
         # router-minted correlation ids for requests arriving without
@@ -862,6 +923,187 @@ class Router:
         if self._probe_thread is not None:
             self._probe_thread.join(5.0)
             self._probe_thread = None
+
+    # -- crash-safe control plane -------------------------------------------
+    def attach_wal(self, wal, epoch: int) -> None:
+        """Wire the durable session journal in (fleet does this
+        BEFORE traffic): every open/token/resume/close is
+        write-ahead journaled, and fresh sids are minted under
+        `epoch` so a restarted router can never collide with a
+        journaled predecessor's ids."""
+        self.wal = wal
+        self.epoch = int(epoch)
+        self.sessions.configure(wal=wal, epoch=epoch,
+                                ttl_s=self.spec.session_ttl_s,
+                                cap=self.spec.session_cap)
+
+    def enter_lame_duck(self, successor: Optional[str] = None,
+                        retry_after: float = 0.5) -> None:
+        self.lame_duck = {"successor": successor,
+                          "retry_after": float(retry_after)}
+        self.log(f"fleet: router entering lame-duck drain "
+                 f"(successor: {successor or 'unannounced'})")
+
+    def _check_lame_duck(self) -> None:
+        ld = self.lame_duck
+        if ld is None:
+            return
+        self.stats.count("lame_duck_refusals")
+        raise LameDuck(
+            "router is draining for handoff; new admissions go to "
+            f"the successor ({ld['successor'] or 'see Retry-After'})",
+            successor=ld["successor"], retry_after=ld["retry_after"])
+
+    def export_control_state(self) -> Dict[str, Any]:
+        """The slow-moving control state worth surviving a restart:
+        quarantine strikes/benches (remaining seconds — monotonic
+        stamps do not cross processes), and the per-(tenant, class)
+        Retry-After streaks.  Rollout/autoscaler state merges in one
+        level up (fleet.py owns those objects)."""
+        now = time.monotonic()
+        with self._lock:
+            members = {n: {
+                "strikes": m.strikes,
+                "quarantined": m.quarantined,
+                "quarantines": m.quarantines,
+                "bench_remaining_s": round(
+                    max(m.bench_until - now, 0.0), 4),
+                "draining": m.draining,
+            } for n, m in self._members.items()}
+        return {"members": members,
+                "shed_streaks": self._shed_backoffs.export_streaks()}
+
+    def restore_control_state(self,
+                              state: Optional[Dict[str, Any]]) -> None:
+        """Re-apply a snapshot by engine NAME (runs after start()'s
+        first probe round): a pre-crash quarantined engine stays
+        benched for its REMAINING bench time — `_probe_one` skips
+        benched members, so restart cannot launder a strike streak."""
+        if not state:
+            return
+        now = time.monotonic()
+        restored = []
+        with self._lock:
+            for n, rec in (state.get("members") or {}).items():
+                m = self._members.get(n)
+                if m is None:
+                    continue          # membership changed: skip
+                m.strikes = max(int(rec.get("strikes", 0)), m.strikes)
+                m.quarantines = max(int(rec.get("quarantines", 0)),
+                                    m.quarantines)
+                if rec.get("quarantined"):
+                    m.quarantined = True
+                    m.healthy = False
+                    m.bench_until = now + float(
+                        rec.get("bench_remaining_s", 0.0))
+                    restored.append(n)
+        self._shed_backoffs.restore_streaks(
+            state.get("shed_streaks") or {})
+        if restored:
+            self.log(f"fleet: restored quarantine benches for "
+                     f"{restored} from control-state snapshot")
+
+    def recover_sessions(self, reduced: Dict[str, Dict[str, Any]],
+                         timeout: Optional[float] = None
+                         ) -> Dict[str, int]:
+        """Re-admit every journaled stream from a predecessor's WAL
+        replay.  Finished streams re-register as replay-only terminal
+        records (a no-op — no engine re-decodes them); live ones
+        re-enter the existing `resume_from` path pinned to their
+        journaled fingerprint and decode into the replay buffer a
+        reconnecting client drains exactly-once."""
+        out = {"terminal": 0, "recovered": 0, "failed": 0}
+        for sid in sorted(reduced):
+            rec = reduced[sid]
+            try:
+                if rec.get("terminal") is not None:
+                    self.sessions.register_terminal(rec)
+                    out["terminal"] += 1
+                else:
+                    self.recover_stream(rec, timeout=timeout)
+                    out["recovered"] += 1
+            except Exception as e:  # noqa: BLE001 — recovery is
+                out["failed"] += 1  # per-stream best-effort
+                self.log(f"fleet: recovery of stream {sid} failed: "
+                         f"{type(e).__name__}: {e}")
+        return out
+
+    def recover_stream(self, rec: Dict[str, Any],
+                       timeout: Optional[float] = None):
+        """Re-admit ONE journaled live stream: open a session under
+        the journaled sid with the journaled prefix (re-journaling
+        both into THIS epoch's WAL, so it is self-contained), then
+        drive the ordinary `_session_stream` consumer — entering via
+        its recovery arm, which admits a resume leg pinned to the
+        journaled fingerprint — into the session's replay buffer on a
+        daemon thread.  The deadline is re-anchored fresh: the
+        original died with the crash, and recovery owes the client
+        its journaled tokens either way."""
+        timeout = (float(timeout) if timeout is not None
+                   else self.spec.request_timeout_s)
+        deadline = qos.resolve_deadline(
+            timeout, None, self.spec.request_timeout_s)
+        priority = str(rec.get("priority") or "interactive")
+        tenant = self.tenancy.label(rec.get("tenant"))
+        session = self.sessions.open(
+            prompt=np.asarray(rec.get("prompt") or [], np.int32),
+            max_new=rec.get("max_new"), deadline=deadline,
+            priority=priority, engine=rec.get("engine") or "",
+            step=int(rec.get("step", -1)), tenant=tenant,
+            family=rec.get("family"), sid=rec["sid"],
+            emitted=rec.get("emitted"))
+        session.attachable = True
+        session.resumes = int(rec.get("resumes", 0))
+        # seed the replay buffer with the journaled prefix: a client
+        # that reconnects with resume_from=0 (lost everything) is owed
+        # the WHOLE stream, not just the post-splice tail — attach()
+        # drops indices below resume_from, so clients that kept their
+        # prefix skip these for free
+        for i, t in enumerate(session.emitted):
+            session.replay_append({"token": int(t), "i": i,
+                                   "sid": session.sid})
+        self.stats.observe_routed(tenant)
+        err = EngineUnavailable(
+            f"router restarted under epoch {self.epoch}; "
+            f"re-admitting journaled stream {session.sid}")
+        gen = self._session_stream(session, None, time.monotonic(),
+                                   priority, timeout, initial_err=err)
+
+        def drive():
+            try:
+                for ev in gen:
+                    session.replay_append(ev)
+            except BaseException as e:  # noqa: BLE001 — honest
+                session.replay_append({   # terminal for the client
+                    "done": True, "finish": "failed",
+                    "error": f"{type(e).__name__}: {e}",
+                    "tokens": list(session.emitted),
+                    "sid": session.sid, "step": session.step})
+            finally:
+                session.replay_finish()
+
+        threading.Thread(target=drive,
+                         name=f"recover-{session.sid}",
+                         daemon=True).start()
+        return session
+
+    def attach_stream(self, sid: str, resume_from: int = 0):
+        """Reconnect a client to a recovered (or replay-retained
+        terminal) stream by `X-Session-Id`: yields the continuation
+        from token index `resume_from` exactly-once.  Raises
+        `UnknownSession` (HTTP 410) for an unjournaled/evicted sid,
+        ValueError (400) for a live never-crashed stream — its
+        original connection still owns it."""
+        session = self.sessions.get(sid)
+        if session is None:
+            raise UnknownSession(
+                f"unknown or expired session {sid!r}")
+        if not session.attachable:
+            raise ValueError(
+                f"session {sid!r} is live on its original "
+                f"connection and cannot be attached")
+        self.sessions.stats.count("attached")
+        return session.attach(resume_from=int(resume_from))
 
     # -- membership reads ---------------------------------------------------
     def names(self) -> List[str]:
@@ -1306,6 +1548,7 @@ class Router:
         family — an unserved family raises `UnknownModel` (the honest
         fast 404) before any engine is picked.  The result carries
         `engine`, the member that served it."""
+        self._check_lame_duck()
         priority = qos.check_priority(priority)
         tenant = self.tenancy.label(tenant)
         family = self._check_family(model)
@@ -1600,6 +1843,7 @@ class Router:
         may already be on the wire and a replay would duplicate them.
         The engine's in-flight slot is held until the consumer
         exhausts (or abandons) the stream."""
+        self._check_lame_duck()
         priority = qos.check_priority(priority)
         tenant = self.tenancy.label(tenant)
         family = self._check_family(model)
@@ -1713,7 +1957,8 @@ class Router:
 
     def _session_stream(self, session, leg, t0: float, priority: str,
                         timeout: Optional[float], p0=None, pa=None,
-                        p1=None, link=None, hedged: bool = False):
+                        p1=None, link=None, hedged: bool = False,
+                        initial_err=None):
         """Consumer loop of a durable stream: journals every token by
         absolute sequence number, dedupes the splice (each index
         reaches the client AT MOST once), arms the per-stream idle
@@ -1725,10 +1970,15 @@ class Router:
         route_stream (tracer clock); the terminal records the stream
         stages post-hoc against `link`."""
         sstats = self.sessions.stats
+        wal = self.sessions.wal
         idle = float(self.spec.stream_idle_s)
         state = "failed"
         finished = False
         staged = False
+        # the durable-session protocol: the FIRST event a client sees
+        # carries the sid (X-Session-Id's value) + router epoch, so a
+        # reconnect after a crash/handoff can attach to the journal
+        sent_first = False
 
         def _finish(outcome: str) -> None:
             """Terminal bookkeeping, exactly once: post-hoc stream
@@ -1787,6 +2037,9 @@ class Router:
             suffix), marked `spliced` when any failover happened."""
             out = dict(ev)
             out["engine"] = session.engine
+            out.setdefault("sid", session.sid)
+            if self.epoch:
+                out.setdefault("epoch", self.epoch)
             if session.emitted or "tokens" in out:
                 out["tokens"] = list(session.emitted)
             if session.resumes:
@@ -1800,7 +2053,18 @@ class Router:
             return out
 
         try:
-            while True:
+            if leg is None:
+                # recovery arm: a WAL-recovered stream enters with no
+                # live leg — the crash WAS the leg's death, so admit
+                # the resume leg through the ordinary failover path
+                # (pinned fingerprint, resume_from = journaled-prefix
+                # length); None means the journal was already complete
+                leg = self._failover_leg(
+                    session, None,
+                    initial_err or EngineUnavailable(
+                        f"recovered stream {session.sid} has no "
+                        f"live leg"), timeout)
+            while leg is not None:
                 try:
                     entry = session.q.get(
                         timeout=idle if idle > 0 else None)
@@ -1861,6 +2125,18 @@ class Router:
                         break
                     continue
                 session.record(ev["token"])
+                if wal is not None:
+                    # write-ahead of delivery: the journal sees the
+                    # token before the client does (group-committed
+                    # off the critical path by the WAL's flusher)
+                    wal.append_tok(session.sid, session.next_i - 1,
+                                   int(ev["token"]))
+                if not sent_first:
+                    ev = dict(ev)
+                    ev["sid"] = session.sid
+                    if self.epoch:
+                        ev["epoch"] = self.epoch
+                    sent_first = True
                 yield ev
             # _failover_leg returned None: the journal already holds
             # every token (the leg died between its last token and
@@ -1876,6 +2152,7 @@ class Router:
             _finish(state)
             yield {"done": True, "finish": "failover_stale",
                    "engine": session.engine, "step": session.step,
+                   "sid": session.sid,
                    "tokens": list(session.emitted),
                    "resumes": session.resumes, "error": str(e)}
         finally:
@@ -1910,16 +2187,19 @@ class Router:
         journal is already complete."""
         sstats = self.sessions.stats
         old_engine = session.engine
-        old_leg.abandon()
+        if old_leg is not None:
+            old_leg.abandon()
         sstats.count("failovers")
         session.resumes += 1
         session.state = "failed_over"
         with self._lock:
             m = self._members.get(old_engine)
             draining = m is None or m.draining
-        if not draining:
+        if old_leg is not None and not draining:
             # a deliberate retirement is not the engine's fault; a
-            # mid-stream death is
+            # mid-stream death is.  Recovery (old_leg None) never
+            # strikes: the ROUTER died, not the engine — and the
+            # journaled engine is a fine resume candidate.
             self._strike(old_engine, f"stream leg failed: {err}")
         if self.spec.resume != "on":
             raise err
@@ -1946,7 +2226,7 @@ class Router:
         # and the shared bucket, never a neighbor's floor
         tbudget = self.tenancy.budget(
             getattr(session, "tenant", "default"))
-        tried = {old_engine}
+        tried = {old_engine} if old_leg is not None else set()
         while True:
             if not tbudget.spend():
                 sstats.count("resume_denied")
@@ -2030,6 +2310,8 @@ class Router:
                 continue
             session.engine = name
             sstats.count("resumed")
+            if self.sessions.wal is not None:
+                self.sessions.wal.append_resume(session.sid, name, at)
             obs.emit_event("stream.resume", sid=session.sid,
                            from_engine=old_engine, engine=name,
                            at=at, resumes=session.resumes,
@@ -2109,6 +2391,8 @@ class Router:
         out["families"] = self.families()
         out["by_tenant"] = self.stats.tenants.snapshot()
         out["tenancy"] = self.tenancy.snapshot()
+        out["epoch"] = self.epoch
+        out["lame_duck"] = self.lame_duck is not None
         return out
 
 

@@ -182,9 +182,31 @@ class InferenceServer:
         return self._httpd.server_address if self._httpd else None
 
     def _poll_loop(self) -> None:
+        """Supervised reload poll: `poll_reload` already contains the
+        expected degradations (failed reloads count + keep serving),
+        but an UNEXPECTED exception here used to kill the daemon
+        thread silently — the engine then served stale params forever
+        behind a healthy /healthz.  Now a death is counted
+        (`reload_poll_deaths`), the loop restarts itself after a
+        Backoff delay, and `engine.health()` degrades once the death
+        streak crosses `degraded_after` (the router stops dispatching
+        to a poller that cannot stay alive)."""
+        from ..utils import faults
         period = max(float(self.engine.spec.reload_poll_s), 0.01)
+        backoff = faults.Backoff(base=period, cap=max(period * 16, 5.0),
+                                 seed=0)
         while not self._poll_stop.wait(period):
-            self.engine.poll_reload()
+            try:
+                self.engine.poll_reload()
+                self.engine.note_poll_ok()
+            except Exception as e:  # noqa: BLE001 — supervised restart
+                streak = self.engine.note_poll_death()
+                self.stats.count("reload_poll_deaths")
+                self.log(f"warning: reload poll died "
+                         f"({type(e).__name__}: {e}); restarting "
+                         f"(streak {streak})")
+                if self._poll_stop.wait(backoff.delay(streak - 1)):
+                    return
 
     # -- in-process client API ---------------------------------------------
     def generate(self, tokens, timeout: Optional[float] = None,

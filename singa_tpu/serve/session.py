@@ -44,12 +44,19 @@ import itertools
 import queue
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from collections import OrderedDict
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
 STREAM_STATES = ("journaled", "failed_over", "spliced", "done",
                  "failed", "failover_stale")
+
+#: terminal-session retention defaults (SessionManager.configure):
+#: closed sessions are kept briefly so a reconnecting client can
+#: replay the finished stream (exactly-once attach), then evicted
+SESSION_TTL_S = 300.0
+SESSION_CAP = 1024
 
 
 class StreamStats:
@@ -59,7 +66,7 @@ class StreamStats:
     FIELDS = ("opened", "done", "failed", "failovers", "resumed",
               "spliced", "dup_tokens", "gap_events", "idle_timeouts",
               "kicked", "resume_faults", "resume_denied",
-              "failover_stale")
+              "failover_stale", "sessions_evicted", "attached")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -128,6 +135,49 @@ class StreamSession:
         # it tagged with its leg object, kicks are tagged None — see
         # module docstring for why a zombie leg is harmless
         self.q: "queue.Queue" = queue.Queue()
+        # replay buffer (crash recovery): a WAL-recovered stream has
+        # no connected client, so the recovery driver parks its
+        # spliced events here and a reconnecting client (attach by
+        # X-Session-Id) drains them exactly-once from `resume_from`
+        self.attachable = False
+        self.replay: List[Dict[str, Any]] = []
+        self.replay_done = False
+        self.replay_cond = threading.Condition()
+
+    def replay_append(self, ev: Dict[str, Any]) -> None:
+        with self.replay_cond:
+            self.replay.append(ev)
+            self.replay_cond.notify_all()
+
+    def replay_finish(self) -> None:
+        with self.replay_cond:
+            self.replay_done = True
+            self.replay_cond.notify_all()
+
+    def attach(self, resume_from: int = 0
+               ) -> Iterator[Dict[str, Any]]:
+        """Drain the replay buffer from token index `resume_from` —
+        the reconnect path.  Token events below `resume_from` are
+        skipped (the client already has them: exactly-once across
+        the reconnect); control/terminal events always pass."""
+        if not self.attachable:
+            raise ValueError(f"session {self.sid!r} not attachable")
+        pos = 0
+        while True:
+            with self.replay_cond:
+                while (pos >= len(self.replay)
+                       and not self.replay_done):
+                    self.replay_cond.wait(0.25)
+                evs = self.replay[pos:]
+                done = self.replay_done
+            pos += len(evs)
+            for ev in evs:
+                if ("token" in ev
+                        and int(ev.get("i", 0)) < int(resume_from)):
+                    continue
+                yield ev
+            if done:
+                return
 
     def record(self, token: int) -> None:
         """Journal token `next_i` (caller already deduped by index)."""
@@ -167,32 +217,140 @@ class SessionManager:
         self.stats = StreamStats()
         self._lock = threading.Lock()
         self._sessions: Dict[str, StreamSession] = {}
+        # terminal sessions retained (bounded) for reconnect replay:
+        # insertion order == close order, so TTL/cap eviction pops
+        # from the front — `kick_engine` never scans these, keeping
+        # it O(live) not O(ever-opened)
+        self._terminal: "OrderedDict[str, Tuple[StreamSession, float]]" \
+            = OrderedDict()
         self._ids = itertools.count(1)
+        self.wal = None               # SessionWal when durability is on
+        self.epoch = 0                # router epoch (0 = no WAL)
+        self.ttl_s = SESSION_TTL_S
+        self.cap = SESSION_CAP
+
+    def configure(self, wal=None, epoch: int = 0,
+                  ttl_s: Optional[float] = None,
+                  cap: Optional[int] = None) -> None:
+        """Attach the durability plumbing (fleet wires this before
+        traffic): the WAL every open/token/close journals into, the
+        router epoch that namespaces fresh sids (a restarted router
+        must never mint a sid colliding with a journaled one), and
+        the terminal-retention bounds."""
+        self.wal = wal
+        self.epoch = int(epoch)
+        if ttl_s is not None:
+            self.ttl_s = max(float(ttl_s), 0.0)
+        if cap is not None:
+            self.cap = max(int(cap), 0)
 
     def open(self, prompt, max_new: Optional[int],
              deadline: Optional[float], priority: str,
              engine: str, step: int, corr: Optional[str] = None,
              trace=None, tenant: str = "default",
-             family: Optional[str] = None) -> StreamSession:
-        sid = f"stream-{next(self._ids)}"
+             family: Optional[str] = None, sid: Optional[str] = None,
+             emitted: Optional[List[int]] = None) -> StreamSession:
+        if sid is None:
+            n = next(self._ids)
+            sid = (f"s{self.epoch}-{n}" if self.epoch
+                   else f"stream-{n}")
         s = StreamSession(sid, prompt, max_new, deadline, priority,
                           engine, step, corr=corr, trace=trace,
                           tenant=tenant, family=family)
+        # a recovered session re-enters with its journaled prefix
+        for t in (emitted or []):
+            s.record(int(t))
         with self._lock:
             self._sessions[sid] = s
+            self._terminal.pop(sid, None)
         self.stats.count("opened")
+        if self.wal is not None:
+            rem = (max(deadline - time.monotonic(), 0.0)
+                   if deadline is not None else None)
+            # write-ahead of the first token: the open record is what
+            # lets a post-crash replay re-derive the decode.  A
+            # recovered open re-journals prefix and all into the NEW
+            # epoch's WAL, so each journal is self-contained.
+            self.wal.append_open(sid, s.prompt.tolist(), s.max_new,
+                                 priority, tenant, family, step, rem)
+            for i, t in enumerate(s.emitted):
+                self.wal.append_tok(sid, i, t)
+        self._evict()
         return s
 
     def close(self, session: StreamSession, state: str) -> None:
         session.state = state
         with self._lock:
             self._sessions.pop(session.sid, None)
+            self._terminal[session.sid] = (
+                session, time.monotonic() + self.ttl_s)
+            self._terminal.move_to_end(session.sid)
+        if self.wal is not None:
+            self.wal.append_close(session.sid, state)
         if state in ("done", "spliced"):
             self.stats.count("done")
         elif state == "failover_stale":
             self.stats.count("failover_stale")
         else:
             self.stats.count("failed")
+        self._evict()
+
+    def get(self, sid: str) -> Optional[StreamSession]:
+        """Live session, or a retained terminal one (reconnect)."""
+        with self._lock:
+            s = self._sessions.get(sid)
+            if s is not None:
+                return s
+            ent = self._terminal.get(sid)
+            return ent[0] if ent is not None else None
+
+    def register_terminal(self, rec: Dict[str, Any]
+                          ) -> StreamSession:
+        """Re-register a stream the WAL shows finished BEFORE the
+        crash: replaying it is a pure journal read — a reconnecting
+        client gets the journaled tokens + terminal event, and no
+        engine ever re-decodes a finished stream."""
+        s = StreamSession(
+            rec["sid"], np.asarray(rec.get("prompt") or [], np.int32),
+            rec.get("max_new"), None,
+            rec.get("priority") or "interactive",
+            rec.get("engine") or "", int(rec.get("step", -1)),
+            tenant=rec.get("tenant") or "default",
+            family=rec.get("family"))
+        for t in rec.get("emitted") or []:
+            s.record(int(t))
+        s.state = rec.get("terminal") or "done"
+        s.attachable = True
+        for i, t in enumerate(s.emitted):
+            s.replay.append({"token": int(t), "i": i})
+        finish = ("length" if s.state in ("done", "spliced")
+                  else s.state)
+        s.replay.append({"done": True, "finish": finish,
+                         "n": len(s.emitted),
+                         "tokens": list(s.emitted),
+                         "sid": s.sid, "step": s.step,
+                         "replayed": True})
+        s.replay_done = True
+        with self._lock:
+            self._terminal[s.sid] = (
+                s, time.monotonic() + self.ttl_s)
+        return s
+
+    def _evict(self) -> None:
+        """Lazy TTL/cap eviction of retained terminal sessions —
+        the bound that keeps the manager O(live) forever."""
+        now = time.monotonic()
+        evicted = 0
+        with self._lock:
+            while self._terminal:
+                _, (_, expiry) = next(iter(self._terminal.items()))
+                if expiry <= now or len(self._terminal) > self.cap:
+                    self._terminal.popitem(last=False)
+                    evicted += 1
+                else:
+                    break
+        if evicted:
+            self.stats.count("sessions_evicted", evicted)
 
     def kick_engine(self, engine: str, why: str) -> int:
         """Fail every live session on `engine` over to a sibling
@@ -214,7 +372,9 @@ class SessionManager:
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             sessions = [s.snapshot() for s in self._sessions.values()]
+            retained = len(self._terminal)
         out: Dict[str, Any] = dict(self.stats.snapshot())
         out["active"] = len(sessions)
+        out["terminal_retained"] = retained
         out["sessions"] = sessions
         return out

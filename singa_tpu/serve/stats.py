@@ -121,6 +121,10 @@ class ServeStats:
         self.reload_failures = 0   # restore raised → kept old params
         self.reloads_refused = 0   # nothing newer / unhealthy walk-back
         self.torn_polls = 0        # poll raced a live writer → no change
+        self.reload_poll_deaths = 0  # poll daemon died on an
+                                     # unexpected exception (restarted
+                                     # under Backoff; /healthz degrades
+                                     # on a persistent streak)
         # real Prometheus histograms (cumulative buckets + _sum/_count)
         # created by register_into(); None until then so the hot path
         # costs one attribute check when /metrics is not wired
@@ -333,7 +337,8 @@ class ServeStats:
                     "generated_tokens", "batches",
                     "batched_requests", "batch_slots", "cb_steps",
                     "compiles", "reloads", "reload_failures",
-                    "reloads_refused", "torn_polls")
+                    "reloads_refused", "torn_polls",
+                    "reload_poll_deaths")
         gauges = ("queue_depth", "consecutive_batch_failures", "qps",
                   "qps_recent", "uptime_s", "p50_latency_ms",
                   "p95_latency_ms", "p99_latency_ms",
@@ -413,6 +418,7 @@ class ServeStats:
                 "reload_failures": self.reload_failures,
                 "reloads_refused": self.reloads_refused,
                 "torn_polls": self.torn_polls,
+                "reload_poll_deaths": self.reload_poll_deaths,
             }
         out["qps"] = round(self.qps(), 3)
         out["qps_recent"] = round(self.qps_recent(), 3)

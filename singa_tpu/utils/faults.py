@@ -134,7 +134,8 @@ SITES = ("data.decode", "data.prefetch", "feed.stage", "ckpt.save",
          "step.grad", "serve.admit", "serve.batch", "serve.reload",
          "serve.hedge", "engine.stall", "fleet.dispatch",
          "fleet.rollout", "pipeline.publish", "scale.decide",
-         "obs.emit", "serve.resume", "obs.flush")
+         "obs.emit", "serve.resume", "obs.flush", "router.wal",
+         "router.recover")
 
 KINDS = ("error", "preempt", "corrupt", "torn", "nan", "spike",
          "stall")

@@ -80,6 +80,14 @@ REQUIRED_GATES = {
         "budget_floor_b_admitted", "budget_floor_a_exhausted",
         "zero_failures", "zero_harness_drops",
     ),
+    "BENCH_pr19.json": (
+        "restart_stream_failures", "restart_dup_tokens",
+        "restart_missing_tokens", "restart_parity_mismatch",
+        "restart_recovered_streams", "handoff_client_failures",
+        "handoff_refusal_points_successor", "handoff_parity_mismatch",
+        "state_quarantine_survived", "state_shed_streak_survived",
+        "wal_overhead_ratio", "wal_fault_counted_loss",
+    ),
 }
 
 # --trajectory: tracked keys -> (direction, tolerance factor).  The
