@@ -2117,6 +2117,288 @@ def bench_failover_smoke(out=None):
     return result
 
 
+def bench_transport_smoke(out=None):
+    """ISSUE 20 acceptance (docs/SERVING.md "Wire protocol"): the
+    zero-copy binary transport against the HTTP/JSON debug surface.
+    Five legs on one warm engine (cb=on) plus a two-engine fleet:
+
+      * A/B leg: interleaved closed-loop unary decodes over ONE
+        persistent binary connection vs the keep-alive HTTP handle.
+        Gates: binary p50 < HTTP p50, and the `singa_wire_*`
+        serialization-time split shows the binary encode path
+        spending LESS wall time than the JSON path spends per token
+        (where the saved time comes from);
+      * PARITY leg: the streamed token sequence over the binary
+        transport is BIT-IDENTICAL to the HTTP ndjson stream and to
+        the unary result (greedy determinism across transports);
+      * SPLICE leg: a mixed fleet (one binary-capable engine, one
+        HTTP-only) loses the binary engine mid-stream — the session
+        machinery splices the remainder from the HTTP sibling with
+        zero client-visible failures, zero duplicate and zero missing
+        tokens, bit-identical to an uninterrupted reference;
+      * FUZZ leg: garbage magic, truncations at every cut point,
+        oversized length prefixes and random bytes against the live
+        listener — every one is a counted `wire_malformed_total`
+        close within the timeout, never a hang, and the listener
+        keeps serving;
+      * FAULT leg: `wire.frame` drop/corrupt/tear injected on the
+        binary path — the negotiating handle absorbs each one by
+        falling back to HTTP with zero client-visible failures.
+    `out` writes the JSON line to a file as well
+    (scripts/transport_smoke.sh -> BENCH_pr20.json)."""
+    import socket as _socket
+    import tempfile
+    import threading
+
+    import jax
+
+    from singa_tpu.core.net import build_net
+    from singa_tpu.models.transformer import transformer_lm
+    from singa_tpu.serve import (BinaryEngineHandle, EngineFleet,
+                                 HttpEngineHandle, InferenceEngine,
+                                 InferenceServer,
+                                 NegotiatingEngineHandle, RouterSpec,
+                                 ServeSpec, wire)
+    from singa_tpu.utils.checkpoint import CheckpointManager
+    from singa_tpu.utils.faults import FaultSchedule, inject
+
+    vocab, plen, seq = 64, 4, 64
+    cfg = transformer_lm(vocab_size=vocab, num_layers=2, embed_dim=32,
+                         num_heads=4, head_dim=8, seq_len=seq,
+                         batchsize=2)
+    net = build_net(cfg, "kTest",
+                    {"data": {"input": (seq,), "target": (seq,)}})
+    params = net.init_params(jax.random.PRNGKey(0))
+    spec = ServeSpec(buckets=((2, seq),), max_new_tokens=32,
+                     batch_window_s=0.002, request_timeout_s=60.0,
+                     cb="on", cb_slots=3, cb_block_len=8)
+
+    def make_server(wire_on=True):
+        eng = InferenceEngine(net, spec, params=params,
+                              log_fn=lambda s: None)
+        srv = InferenceServer(eng, port=0, wire_on=wire_on,
+                              log_fn=lambda s: None)
+        srv.start()
+        return srv
+
+    prompt = np.arange(1, 1 + plen, dtype=np.int32)
+    srv = make_server(wire_on=True)
+    host, port = srv.address
+    hh = HttpEngineHandle("e0", f"http://{host}:{port}")
+    bh = BinaryEngineHandle("e0", srv.wire_address)
+
+    # -- A/B leg: interleaved closed-loop unary decodes ---------------
+    n_ab = 40
+    for _ in range(4):                   # warm both paths + compile
+        hh.request("generate", prompt, timeout=30)
+        bh.request("generate", prompt, timeout=30)
+    lat = {"http": [], "binary": []}
+    for _ in range(n_ab):
+        for name, h in (("http", hh), ("binary", bh)):
+            t0 = time.perf_counter()
+            h.request("generate", prompt, timeout=30)
+            lat[name].append(time.perf_counter() - t0)
+    p50_http = float(np.median(lat["http"]) * 1e3)
+    p50_bin = float(np.median(lat["binary"]) * 1e3)
+
+    # serialization split: stream the SAME decode over each transport
+    # and charge the per-token encode cost to its own accumulator
+    def _delta(before, after, *keys):
+        return sum(after[k] - before[k] for k in keys)
+
+    s_tokens = 32
+    pre = wire.STATS.snapshot()
+    http_stream = [ev for ev in hh.request_stream(
+        prompt, timeout=60, max_new=s_tokens)]
+    mid = wire.STATS.snapshot()
+    bin_stream = [ev for ev in bh.request_stream(
+        prompt, timeout=60, max_new=s_tokens)]
+    post = wire.STATS.snapshot()
+    ser_http_s = _delta(pre, mid, "json_ser_seconds",
+                        "ser_seconds")
+    ser_bin_s = _delta(mid, post, "json_ser_seconds", "ser_seconds")
+    flushes = _delta(pre, post, "token_flushes")
+
+    # -- PARITY leg ---------------------------------------------------
+    ref = hh.request("generate", prompt, timeout=30)["tokens"]
+    h_toks = [ev["token"] for ev in http_stream if "done" not in ev]
+    b_toks = [ev["token"] for ev in bin_stream if "done" not in ev]
+    parity_mismatch = int(h_toks != ref) + int(b_toks != ref)
+
+    # -- FUZZ leg -----------------------------------------------------
+    whole = b"".join(bytes(p) for p in wire.frame_parts(
+        wire.K_REQ, 7, wire.encode_qos_header(tenant="t"),
+        [wire.encode_request(wire.OP_GENERATE, [1, 2, 3])]))
+    rng = np.random.default_rng(11)
+    cases = [b"XX" + b"\x00" * 14,
+             wire._PREAMBLE.pack(wire.MAGIC, wire.VERSION + 1,
+                                 wire.K_HELLO, 0, 0, 1, 0, 0),
+             wire._PREAMBLE.pack(wire.MAGIC, wire.VERSION,
+                                 wire.K_REQ, 0, 0, 1, 0,
+                                 wire.MAX_PAYLOAD_LEN + 1)]
+    cases += [whole[:cut] for cut in range(1, len(whole), 7)]
+    cases += [rng.integers(0, 256, int(rng.integers(1, 48)))
+              .astype(np.uint8).tobytes() for _ in range(25)]
+    fuzz_pre = wire.STATS.snapshot()["malformed"]
+    fuzz_hangs = 0
+    for raw in cases:
+        s = _socket.create_connection(srv.wire_address, timeout=5.0)
+        try:
+            s.sendall(raw)
+            s.shutdown(_socket.SHUT_WR)  # half-close: no more bytes
+            s.settimeout(5.0)
+            while s.recv(4096):          # drain until peer closes
+                pass
+        except (TimeoutError, _socket.timeout):
+            fuzz_hangs += 1
+        except OSError:
+            pass                         # reset counts as closed
+        finally:
+            s.close()
+    fuzz_malformed = wire.STATS.snapshot()["malformed"] - fuzz_pre
+    fuzz_survived = int(bh.probe().get("ok", False))
+    hh.close()
+    bh.close()
+
+    # -- FAULT leg: wire.frame absorbed by HTTP fallback --------------
+    fault_failures = 0
+    fault_pre = wire.STATS.snapshot()["faulted_frames"]
+    for kind in ("error", "corrupt", "torn"):
+        nh = NegotiatingEngineHandle("e0", f"http://{host}:{port}",
+                                     connect_timeout_s=3.0,
+                                     log_fn=lambda s: None)
+        try:
+            nh.probe()
+            with inject(FaultSchedule.parse(f"wire.frame@0:{kind}")):
+                got = nh.request("generate", prompt, timeout=30)
+            if len(got["tokens"]) != s_tokens:
+                fault_failures += 1
+        except Exception:  # noqa: BLE001 — gated below
+            fault_failures += 1
+        finally:
+            nh.close()
+    faulted = wire.STATS.snapshot()["faulted_frames"] - fault_pre
+    srv.stop()
+
+    # -- SPLICE leg: mixed fleet loses the binary engine mid-stream ---
+    s_max = 32
+    a = make_server(wire_on=True)
+    b = make_server(wire_on=False)
+    ws = tempfile.mkdtemp(prefix="transport_smoke_")
+    CheckpointManager(ws, log_fn=lambda s: None).save(
+        1, params, {"t": np.zeros(())}, health={"verdict": "ok"})
+    rspec = RouterSpec(probe_period_s=0.1, hedge="off",
+                       request_timeout_s=60.0, wal_group_tokens=4,
+                       wal_group_ms=5.0, state_snapshot_s=0.1)
+    fleet = EngineFleet.adopt(
+        [f"http://{h}:{p}" for h, p in (a.address, b.address)],
+        workspace=ws, router_spec=rspec, log_fn=lambda s: None)
+    splice_failures, splice_dup, splice_missing = 1, 0, 0
+    splice_parity = 1
+    try:
+        fleet.start()
+        deadline = time.monotonic() + 10.0
+        h0 = fleet.router.handle_for("engine-0")
+        while time.monotonic() < deadline and \
+                h0.transport != "binary":
+            time.sleep(0.05)
+        splice_transport = h0.transport
+        sref = [ev["token"]
+                for ev in fleet.generate_stream(prompt,
+                                                max_new=s_max)
+                if "token" in ev]
+        seen, idx, killed, err = [], [], False, None
+        try:
+            for ev in fleet.generate_stream(prompt, max_new=s_max):
+                if "token" not in ev:
+                    continue
+                seen.append(int(ev["token"]))
+                idx.append(int(ev["i"]))
+                if len(seen) == 4 and not killed:
+                    killed = True
+                    a.stop()             # the whole binary worker
+        except Exception as e:  # noqa: BLE001 — gated below
+            err = f"{type(e).__name__}: {e}"
+        splice_failures = int(err is not None)
+        splice_dup = len(idx) - len(set(idx))
+        splice_missing = len(set(range(s_max)) - set(idx))
+        splice_parity = int(seen != sref)
+    finally:
+        fleet.stop()
+        b.stop()
+        try:
+            a.stop()
+        except Exception:  # noqa: BLE001 — may already be down
+            pass
+
+    gates = {
+        "transport_p50_improved": {
+            "value": round(p50_bin, 3), "bound": round(p50_http, 3),
+            "op": "<", "pass": bool(p50_bin < p50_http)},
+        "transport_ser_time_reduced": {
+            "value": round(ser_bin_s * 1e6, 1),
+            "bound": round(ser_http_s * 1e6, 1), "op": "<",
+            "pass": bool(ser_bin_s < ser_http_s)},
+        "transport_stream_parity": {
+            "value": parity_mismatch, "bound": 0, "op": "==",
+            "pass": bool(parity_mismatch == 0)},
+        "wire_splice_exactly_once": {
+            "value": splice_failures + splice_dup + splice_missing
+            + splice_parity, "bound": 0, "op": "==",
+            "pass": bool(splice_failures == 0 and splice_dup == 0
+                         and splice_missing == 0
+                         and splice_parity == 0)},
+        "wire_fuzz_no_hangs": {
+            "value": fuzz_hangs, "bound": 0, "op": "==",
+            "pass": bool(fuzz_hangs == 0
+                         and fuzz_malformed >= len(cases) - 2
+                         and fuzz_survived)},
+        "wire_fault_absorbed": {
+            "value": fault_failures, "bound": 0, "op": "==",
+            "pass": bool(fault_failures == 0 and faulted >= 3)},
+    }
+    failures = [f"{k}: {g['value']} not {g['op']} {g['bound']}"
+                for k, g in gates.items() if not g["pass"]]
+    if failures:
+        raise RuntimeError("transport smoke FAILED: "
+                           + "; ".join(failures))
+
+    result = {
+        "metric": "transport_p50_ms",
+        "value": round(p50_bin, 3),
+        "unit": "ms",
+        "http_p50_ms": round(p50_http, 3),
+        "requests_per_leg": n_ab,
+        "ab_leg": {
+            "binary_p50_ms": round(p50_bin, 3),
+            "http_p50_ms": round(p50_http, 3),
+            "binary_ser_us": round(ser_bin_s * 1e6, 1),
+            "http_ser_us": round(ser_http_s * 1e6, 1),
+            "stream_tokens": s_tokens,
+            "token_flushes": flushes},
+        "parity_leg": {"mismatch": parity_mismatch,
+                       "tokens": len(ref)},
+        "splice_leg": {"failures": splice_failures,
+                       "dup": splice_dup,
+                       "missing": splice_missing,
+                       "parity_mismatch": splice_parity,
+                       "transport_before_kill": splice_transport},
+        "fuzz_leg": {"cases": len(cases), "hangs": fuzz_hangs,
+                     "malformed_counted": fuzz_malformed,
+                     "listener_survived": fuzz_survived},
+        "fault_leg": {"client_failures": fault_failures,
+                      "faulted_frames": faulted},
+        "wire_stats": wire.STATS.snapshot(),
+        "gates": gates,
+        "backend": jax.default_backend(),
+    }
+    line = json.dumps(result)
+    if out:
+        with open(out, "w") as f:
+            f.write(line + "\n")
+    return result
+
+
 def bench_router_smoke(out=None):
     """ISSUE 19 acceptance (docs/SERVING.md "Control-plane
     durability"): the crash-safe control plane.  Five legs:
@@ -3060,6 +3342,12 @@ def main() -> None:
         if "--out" in sys.argv:
             out = sys.argv[sys.argv.index("--out") + 1]
         print(json.dumps(bench_failover_smoke(out=out)))
+        return
+    if "--transport-smoke" in sys.argv:
+        out = None
+        if "--out" in sys.argv:
+            out = sys.argv[sys.argv.index("--out") + 1]
+        print(json.dumps(bench_transport_smoke(out=out)))
         return
     if "--router-smoke" in sys.argv:
         out = None

@@ -1,0 +1,73 @@
+#!/usr/bin/env bash
+# Transport smoke (ISSUE 20 acceptance): the zero-copy binary wire
+# protocol against the HTTP/JSON debug surface — on CPU.  FAILS unless
+#   * closed-loop unary decodes over one persistent binary connection
+#     beat the keep-alive HTTP handle on p50, and the `singa_wire_*`
+#     serialization-time split shows the binary encode path cheaper
+#     than the JSON path (where the saved time comes from);
+#   * the streamed token sequence is BIT-IDENTICAL across transports;
+#   * killing the binary-capable engine of a mixed fleet mid-stream
+#     splices the remainder from the HTTP-only sibling exactly once;
+#   * frame fuzz (garbage magic, truncations at every cut point,
+#     oversized length prefixes, random bytes) is a counted
+#     `wire_malformed_total` close — never a hang, never a crash;
+#   * injected `wire.frame` drop/corrupt/tear is absorbed by the
+#     negotiating handle's HTTP fallback with zero client-visible
+#     failures.
+# Writes BENCH_pr20.json (per-leg numbers and a `gates` dict).
+#
+# Usage: scripts/transport_smoke.sh       (CPU-only, no data, ~3 min)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+# Leg 1: the bench smoke — A/B, parity, splice, fuzz and fault legs
+# over real engines.  bench_transport_smoke raises (and this script
+# fails) unless every acceptance bullet holds.
+python bench.py --transport-smoke --out BENCH_pr20.json
+
+# the recorded artifact must actually carry the numbers, not nulls,
+# and every gate it records must have passed
+python - <<'EOF'
+import json
+with open("BENCH_pr20.json") as f:
+    d = json.loads(f.read())
+ab = d["ab_leg"]
+assert ab["binary_p50_ms"] < ab["http_p50_ms"], d
+assert ab["binary_ser_us"] < ab["http_ser_us"], d
+assert d["parity_leg"]["mismatch"] == 0, d
+sp = d["splice_leg"]
+assert sp["failures"] == 0 and sp["dup"] == 0, d
+assert sp["missing"] == 0 and sp["parity_mismatch"] == 0, d
+assert sp["transport_before_kill"] == "binary", d
+fz = d["fuzz_leg"]
+assert fz["hangs"] == 0 and fz["listener_survived"] == 1, d
+assert fz["malformed_counted"] >= fz["cases"] - 2, d
+fl = d["fault_leg"]
+assert fl["client_failures"] == 0 and fl["faulted_frames"] >= 3, d
+gates = d.get("gates")
+assert isinstance(gates, dict) and gates, "gates dict missing"
+bad = [k for k, g in gates.items() if not g.get("pass")]
+assert not bad, f"gates failed: {bad}"
+print(f"BENCH_pr20.json ok: binary p50 {ab['binary_p50_ms']}ms vs "
+      f"HTTP {ab['http_p50_ms']}ms, wire encode {ab['binary_ser_us']}"
+      f"us vs JSON {ab['http_ser_us']}us per stream, splice "
+      f"exactly-once over the transport boundary, {fz['cases']} fuzz "
+      f"cases closed without a hang, wire.frame x3 absorbed")
+EOF
+echo "TRANSPORT BENCH PASS: the binary path is faster, bit-identical,"
+echo "  and dies politely — fuzz closes, faults fall back to HTTP"
+
+# Leg 2: the regression suite — frame-codec roundtrips and fuzz
+# hardening, TokenRing semantics, multiplexed persistent connections,
+# negotiation/fallback, cross-transport failover, wire.frame
+# absorption, HTTP keep-alive reuse.
+python -m pytest tests/test_wire.py -q -m wire -p no:cacheprovider
+
+# Leg 3: the report — BENCH_pr20.json lands in the table and its
+# recorded gates are checked (missing/failing gates exit non-zero).
+python tools/bench_report.py | grep -E 'BENCH_pr20' > /dev/null || {
+    echo "BENCH REPORT LEG FAILED"; exit 1; }
+python tools/bench_report.py
+echo "TRANSPORT SMOKE PASS"

@@ -244,6 +244,24 @@ def make_serve_argparser() -> argparse.ArgumentParser:
                          "session WAL, and replays it "
                          "(docs/SERVING.md, control-plane "
                          "durability; needs --fleet/--fleet_hostfile)")
+    ap.add_argument("--wire", action="store_true",
+                    help="start the zero-copy binary framed listener "
+                         "beside the HTTP frontend (ephemeral port "
+                         "unless --wire_port): /healthz advertises "
+                         "it and transport=auto fleet routers "
+                         "upgrade this engine's data plane to it, "
+                         "falling back to HTTP on any wire failure "
+                         "(singa_tpu/serve/wire.py, docs/SERVING.md)")
+    ap.add_argument("--wire_port", type=int, default=0,
+                    help="binary transport port (0 = ephemeral; "
+                         "implies --wire when nonzero)")
+    ap.add_argument("--transport", default="auto",
+                    choices=("auto", "http"),
+                    help="fleet data plane for adopted (hostfile) "
+                         "engines: auto = negotiate binary per "
+                         "engine via /healthz wire_port with "
+                         "automatic HTTP fallback; http = pin the "
+                         "debug surface (singa_tpu/serve/wire.py)")
     ap.add_argument("--fault_spec", default=None,
                     help="deterministic fault injection over the "
                          "serve.* and fleet.* sites "
@@ -309,7 +327,12 @@ def serve_main(argv) -> int:
             server = InferenceServer(engine, host=args.host,
                                      port=args.port,
                                      http=(args.smoke == 0),
-                                     tenancy=tenancy, log_fn=log)
+                                     tenancy=tenancy, log_fn=log,
+                                     wire_on=(args.smoke == 0
+                                              and (args.wire
+                                                   or args.wire_port
+                                                   > 0)),
+                                     wire_port=args.wire_port)
             server.start()
             if engine.params_step < 0:
                 log("warning: serving fresh-init params (no "
@@ -376,7 +399,8 @@ def _fleet_main(args, net, spec, fallback, schedule, log) -> int:
             fleet = EngineFleet.from_hostfile(
                 args.fleet_hostfile, workspace=args.workspace,
                 router_spec=router_spec, rollout_spec=rollout_spec,
-                tenancy=tenancy, standby=args.standby, log_fn=log)
+                tenancy=tenancy, standby=args.standby, log_fn=log,
+                transport=args.transport)
         else:
             fleet = EngineFleet.local(
                 net, spec, args.fleet, workspace=args.workspace,

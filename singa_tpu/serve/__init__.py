@@ -68,6 +68,16 @@ checkpoints via atomic hot-reload.  Layers:
                  tenant ids folded into one bounded `other` envelope
                  (blast-radius containment for the multi-tenant
                  fleet)
+    wire.py      zero-copy binary transport: length-prefixed framed
+                 protocol over persistent sockets (BinaryEngineHandle
+                 / BinaryTransportServer, multiplexed in-flight
+                 requests), shared-memory TokenRing for the in-
+                 process hop, batched token flushes (flush_tokens/
+                 flush_ms) on both wire surfaces, per-engine
+                 negotiation with automatic HTTP fallback
+                 (NegotiatingEngineHandle), singa_wire_* counters
+                 with a serialization-time split — HTTP/JSON stays
+                 the always-on debug surface
 
 Fault sites `serve.admit` / `serve.batch` / `serve.reload` /
 `fleet.dispatch` / `fleet.rollout` / `scale.decide` / `serve.hedge` /
@@ -98,20 +108,27 @@ from .qos import PRIORITIES, ClassBackoffs, RetryBudget
 from .tenancy import (TenantBudget, TenantRegistry, TenantSpec)
 from .traffic import (Phase, TrafficGen, diurnal, flash_crowd,
                       kill_chaos, ramp, stall_chaos, steady)
+from .wire import (BinaryEngineHandle, BinaryTransportServer,
+                   NegotiatingEngineHandle, TokenRing, WireError,
+                   WireStats, WireUnavailable)
 
-__all__ = ["AutoScaler", "AutoScaleSpec", "Cancelled",
+__all__ = ["AutoScaler", "AutoScaleSpec", "BinaryEngineHandle",
+           "BinaryTransportServer", "Cancelled",
            "ClassBackoffs", "ContinuousScheduler",
            "ControlStateStore", "DeadlineExpired",
            "EngineFleet", "EngineUnavailable", "FleetServer",
            "HttpEngineHandle", "InferenceEngine", "InferenceServer",
            "LameDuck", "LocalEngineHandle", "MicroBatcher",
+           "NegotiatingEngineHandle",
            "Overloaded", "PRIORITIES", "PagedKVCache", "Phase",
            "RetryBudget", "RolloutController", "RolloutSpec",
            "Router", "RouterSpec", "RouterStats", "ServeSpec",
            "ServeStats", "SessionManager", "SessionWal",
            "StreamSession", "StreamStats", "StreamTicket",
            "TenantBudget", "TenantRegistry", "TenantSpec", "Ticket",
-           "TrafficGen", "UnknownModel", "UnknownSession", "WalStats",
+           "TokenRing", "TrafficGen", "UnknownModel",
+           "UnknownSession", "WalStats", "WireError", "WireStats",
+           "WireUnavailable",
            "diurnal", "flash_crowd", "kill_chaos", "qos", "ramp",
            "reduce_sessions", "replay_wal", "stall_chaos", "steady",
            "walcheck"]

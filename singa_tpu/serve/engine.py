@@ -106,6 +106,13 @@ class ServeSpec:
     # only, and a failover resume must match BOTH halves.  Parsed
     # lowercase by the str branch of `parse`
     family: str = "default"
+    # token flush batching (serve/wire.py): streamed tokens go out in
+    # frames/chunks of up to `flush_tokens`, lingering `flush_ms` for
+    # stragglers — on both the binary and HTTP ndjson surfaces.  The
+    # first token of a stream always flushes alone (first-token
+    # latency is a gated stage).  flush_tokens=1 disables batching
+    flush_tokens: int = 8
+    flush_ms: float = 4.0
 
     def __post_init__(self):
         norm = []
@@ -150,6 +157,12 @@ class ServeSpec:
         if not fam:
             raise ValueError("family must be a non-empty name")
         object.__setattr__(self, "family", fam)
+        if int(self.flush_tokens) < 1:
+            raise ValueError(f"flush_tokens must be >= 1, got "
+                             f"{self.flush_tokens}")
+        if float(self.flush_ms) < 0:
+            raise ValueError(f"flush_ms must be >= 0, got "
+                             f"{self.flush_ms}")
 
     @property
     def max_prompt_len(self) -> int:

@@ -67,9 +67,11 @@ from .. import obs
 from ..utils import faults
 from ..utils.checkpoint import CheckpointManager
 from .engine import InferenceEngine, ServeSpec
+from . import wire
 from .router import (LameDuck, LocalEngineHandle, Router, RouterSpec,
                      HttpEngineHandle, UnknownSession, _handle_call)
 from .server import InferenceServer
+from .wire import NegotiatingEngineHandle
 from .sessionlog import (ControlStateStore, SessionWal, WalStats,
                          claim_epoch, latest_wal_before, reduce_sessions,
                          replay_wal)
@@ -785,10 +787,28 @@ class EngineFleet:
               router_spec: Optional[RouterSpec] = None,
               rollout_spec: Optional[RolloutSpec] = None,
               tenancy: Optional[TenantRegistry] = None,
-              standby: bool = False, log_fn=print) -> "EngineFleet":
-        """Adopt already-running engine processes by base URL."""
-        handles = [HttpEngineHandle(f"engine-{i}", u)
-                   for i, u in enumerate(urls)]
+              standby: bool = False, log_fn=print,
+              transport: str = "auto") -> "EngineFleet":
+        """Adopt already-running engine processes by base URL.
+
+        `transport` picks the per-engine data plane: "auto" (default)
+        negotiates per engine — the HTTP /healthz probe discovers a
+        `wire_port` and upgrades that engine's requests/streams to
+        the binary framed transport, degrading back to HTTP on any
+        wire failure (serve/wire.py); "http" pins the debug surface
+        unconditionally.  Mixed fleets are first-class: each engine
+        negotiates independently, so routing, hedging, and failover
+        cross the binary/HTTP boundary freely."""
+        if transport not in ("auto", "http"):
+            raise ValueError(f"transport must be auto|http, got "
+                             f"{transport!r}")
+        if transport == "auto":
+            handles = [NegotiatingEngineHandle(f"engine-{i}", u,
+                                               log_fn=log_fn)
+                       for i, u in enumerate(urls)]
+        else:
+            handles = [HttpEngineHandle(f"engine-{i}", u)
+                       for i, u in enumerate(urls)]
         return cls(handles, workspace=workspace,
                    router_spec=router_spec, rollout_spec=rollout_spec,
                    tenancy=tenancy, standby=standby, log_fn=log_fn)
@@ -854,6 +874,15 @@ class EngineFleet:
         for h in self._local:
             if h._alive:
                 h.stop()
+        # remote handles: drop pooled keep-alive sockets and any
+        # persistent binary connections
+        for name in self.router.names():
+            h = self.router.handle_for(name)
+            if h not in self._local and hasattr(h, "close"):
+                try:
+                    h.close()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
 
     def __enter__(self) -> "EngineFleet":
         return self.start()
@@ -985,6 +1014,10 @@ class FleetServer:
         # control-plane durability (singa_router_wal_*): appends,
         # bytes, lost writes, fenced writes, replay/recovery counts
         self.fleet.wal_stats.register_into(self.metrics)
+        # binary-transport counters + serialization-time split
+        # (singa_wire_*): frames, malformed, fallbacks, ser/deser vs
+        # json_ser/json_deser seconds — the transport A/B evidence
+        wire.register_into(self.metrics)
         self._host, self._port = host, port
         self._httpd = None
         self._http_thread: Optional[threading.Thread] = None
@@ -1139,14 +1172,25 @@ class FleetServer:
                     self.send_header(_qos.EPOCH_HEADER,
                                      str(fleet.epoch))
                 self.end_headers()
+                # batched token flushes (serve/wire.py): several
+                # ndjson lines per chunked write under the router
+                # spec's flush knobs.  The coalescer flushes the
+                # first line of the stream immediately — first-token
+                # latency is a gated stage
+                co = wire.LineCoalescer(
+                    self._chunk,
+                    flush_tokens=fleet.router.spec.flush_tokens,
+                    flush_ms=fleet.router.spec.flush_ms)
                 try:
                     for ev in stream:
-                        self._chunk(json.dumps(ev).encode() + b"\n")
+                        co.add(wire.timed_json_dumps(ev) + b"\n",
+                               urgent=bool(ev.get("done")))
                 except Exception as e:  # noqa: BLE001 — mid-stream
-                    self._chunk(json.dumps(
+                    co.add(json.dumps(
                         {"error":
                          f"{type(e).__name__}: {e}"}).encode()
-                        + b"\n")
+                        + b"\n", urgent=True)
+                co.flush()
                 self._chunk(b"")
 
             def do_POST(self):

@@ -191,6 +191,60 @@ def deadline_from_header(value: Optional[str]) -> Optional[float]:
     return time.monotonic() + float(value) / 1000.0
 
 
+# -- header <-> binary-frame mapping (serve/wire.py) -------------------------
+# The binary transport carries the SAME QoS envelope as the HTTP
+# headers, as flat struct fields instead of strings: remaining-ms
+# deadline (i64, -1 = none, re-anchored by the receiver exactly like
+# X-Deadline-Ms), a u8 priority code, and the tenant/trace/session ids
+# as length-prefixed strings.  These helpers are the single source of
+# truth for both directions so the two wire surfaces can never drift.
+
+#: u8 priority code meaning "unspecified" (receiver defaults to
+#: interactive, matching a missing X-Priority header)
+PRIORITY_NONE_CODE = 255
+
+
+def priority_to_code(priority: Optional[str]) -> int:
+    """Priority class -> u8 frame code (index into PRIORITIES;
+    PRIORITY_NONE_CODE for None).  Raises ValueError on an unknown
+    class, same as check_priority."""
+    if priority is None:
+        return PRIORITY_NONE_CODE
+    return PRIORITIES.index(check_priority(priority))
+
+
+def priority_from_code(code: int) -> Optional[str]:
+    """u8 frame code -> priority class (None for PRIORITY_NONE_CODE).
+    An out-of-range code raises ValueError — unlike a garbled tenant,
+    a bad priority code means the frame itself is skewed (the codec
+    maps it to a malformed-frame close, the binary twin of the 400)."""
+    c = int(code)
+    if c == PRIORITY_NONE_CODE:
+        return None
+    if not 0 <= c < len(PRIORITIES):
+        raise ValueError(f"unknown priority code {c}")
+    return PRIORITIES[c]
+
+
+def deadline_to_ms(deadline: Optional[float]) -> int:
+    """Remaining-budget milliseconds for the frame header (-1 = no
+    deadline; floored at 0 so a dead request propagates as dead —
+    the flat-struct twin of deadline_to_header)."""
+    rem = remaining_s(deadline)
+    if rem is None:
+        return -1
+    return max(int(rem * 1000), 0)
+
+
+def deadline_from_ms(ms: int) -> Optional[float]:
+    """Re-anchor a remaining-ms frame field onto THIS process's
+    monotonic clock (the frame twin of deadline_from_header)."""
+    m = int(ms)
+    if m < 0:
+        return None
+    return time.monotonic() + m / 1000.0
+
+
 class RetryBudget:
     """Global token bucket bounding retries + hedges to a fraction of
     primary traffic.  `earn()` once per primary dispatch adds `ratio`

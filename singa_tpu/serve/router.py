@@ -45,6 +45,7 @@ right now" and "move this request somewhere else".
 from __future__ import annotations
 
 import dataclasses
+import http.client
 import inspect
 import itertools
 import json
@@ -141,6 +142,10 @@ class RouterSpec:
     state_snapshot_s: float = 0.5  # control-state snapshot cadence
     session_ttl_s: float = 300.0   # terminal-session retention TTL
     session_cap: int = 1024        # ... and count cap
+    flush_tokens: int = 8          # frontend token-flush batching
+    flush_ms: float = 4.0          # (serve/wire.py LineCoalescer):
+                                   # tokens per ndjson chunk / linger.
+                                   # First token always flushes alone
 
     def __post_init__(self):
         if int(self.quarantine_after) < 1:
@@ -175,6 +180,9 @@ class RouterSpec:
                              "state_snapshot_s > 0")
         if float(self.session_ttl_s) < 0 or int(self.session_cap) < 0:
             raise ValueError("session_ttl_s/session_cap must be >= 0")
+        if int(self.flush_tokens) < 1 or float(self.flush_ms) < 0:
+            raise ValueError("flush_tokens must be >= 1 and flush_ms "
+                             ">= 0")
 
     @classmethod
     def parse(cls, spec: Optional[str]) -> "RouterSpec":
@@ -323,15 +331,48 @@ class LocalEngineHandle:
             deadline, timeout, self.engine.spec.request_timeout_s)
 
         def gen():
+            # in-process hot path: drain the ticket in BATCHES (one
+            # queue round-trip per flush_tokens instead of per token)
+            # and stage them through a shared-memory TokenRing — raw
+            # int32s end to end, nothing serialized, zero bytes
+            # copied out of the ring's buffer (serve/wire.py).  The
+            # first token drains alone: first-token latency is a
+            # gated stage and must not pay for batching
+            from . import wire as _wire
+            spec = self.engine.spec
+            flush_n = max(int(getattr(spec, "flush_tokens", 8)), 1)
+            linger = max(float(getattr(spec, "flush_ms", 4.0)),
+                         0.0) / 1000.0
+            ring = _wire.TokenRing(max(flush_n * 8, 64))
             i = ticket.first_index
-            for kind, payload in ticket.events(timeout=budget):
-                if kind == "tok":
-                    yield {"token": payload, "i": i}
-                    i += 1
-                else:
-                    out = dict(payload)
-                    out["done"] = True
-                    yield out
+            first = True
+            while True:
+                evs = ticket.drain_events(
+                    max_n=1 if first else flush_n,
+                    timeout=budget,
+                    linger_s=0.0 if first else linger)
+                first = False
+                toks = [p for k, p in evs if k == "tok"]
+                if toks:
+                    ring.push_many(toks)
+                    left = len(toks)
+                    while left > 0:
+                        _k, _start, view = ring.peek_batch(left)
+                        for t in view:
+                            yield {"token": int(t), "i": i}
+                            i += 1
+                        ring.consume(len(view))
+                        left -= len(view)
+                    _wire.STATS.count("token_flushes")
+                tail = evs[-1]
+                if tail[0] == "tok":
+                    continue
+                if tail[0] == "failed":
+                    raise tail[1]
+                out = dict(tail[1])
+                out["done"] = True
+                yield out
+                return
         return gen()
 
     def reload(self, step: Optional[int] = None) -> Dict[str, Any]:
@@ -344,13 +385,58 @@ class LocalEngineHandle:
 class HttpEngineHandle:
     """Worker behind a URL: a `singa_tpu.main serve --pinned` process
     (membership from a hostfile).  Maps the server's status codes back
-    to the router's exception vocabulary."""
+    to the router's exception vocabulary.
+
+    Unary calls and probes ride a small keep-alive connection pool:
+    opening a fresh TCP connection per request put connection setup on
+    the hot path (and under probe cadence, several times a second per
+    engine).  A pooled connection is returned after a clean
+    keep-alive exchange and DISCARDED on any error — a socket that
+    failed once is never trusted again.  Streams keep their own
+    dedicated connections: a stream owns its socket for its lifetime,
+    pooling it would just serialize streams behind each other."""
+
+    #: pooled sockets per handle — enough for probe + a hedged pair
+    POOL_CAP = 4
 
     def __init__(self, name: str, base_url: str,
                  connect_timeout_s: float = 5.0):
         self.name = name
         self.base_url = base_url.rstrip("/")
         self.connect_timeout_s = connect_timeout_s
+        netloc = self.base_url.split("//", 1)[-1].split("/", 1)[0]
+        host, _, port = netloc.partition(":")
+        self._host, self._port = host or "127.0.0.1", int(port or 80)
+        self._pool: deque = deque()
+        self._pool_lock = threading.Lock()
+
+    def _acquire_conn(self, timeout: float):
+        """(connection, was_reused) — pop a pooled keep-alive socket
+        or dial a fresh one."""
+        with self._pool_lock:
+            if self._pool:
+                c = self._pool.popleft()
+                if c.sock is not None:
+                    c.sock.settimeout(timeout)
+                return c, True
+        c = http.client.HTTPConnection(self._host, self._port,
+                                       timeout=timeout)
+        return c, False
+
+    def _release_conn(self, conn, reusable: bool) -> None:
+        if reusable:
+            with self._pool_lock:
+                if len(self._pool) < self.POOL_CAP:
+                    self._pool.append(conn)
+                    return
+        conn.close()
+
+    def close(self) -> None:
+        """Drop every pooled socket (fleet teardown)."""
+        with self._pool_lock:
+            conns, self._pool = list(self._pool), deque()
+        for c in conns:
+            c.close()
 
     def _call(self, method: str, path: str,
               payload: Optional[dict] = None,
@@ -361,41 +447,52 @@ class HttpEngineHandle:
                 if payload is not None else None)
         hdrs = {"Content-Type": "application/json"}
         hdrs.update(headers or {})
-        req = urllib.request.Request(
-            f"{self.base_url}{path}", data=data, method=method,
-            headers=hdrs)
-        try:
-            with urllib.request.urlopen(
-                    req, timeout=timeout or self.connect_timeout_s) as r:
-                return json.loads(r.read())
-        except urllib.error.HTTPError as e:
-            # drain + close the error body deterministically: under
-            # retry/hedge churn, leaving it to GC leaks one fd per
-            # failed call until collection runs (the fd-flat
-            # regression test in test_router_wal.py watches this)
+        budget = timeout or self.connect_timeout_s
+        for attempt in (0, 1):
+            conn, reused = self._acquire_conn(budget)
+            try:
+                conn.request(method, path, body=data, headers=hdrs)
+                r = conn.getresponse()
+                # drain the body BEFORE judging the status: an error
+                # reply is a socket too, and under retry/hedge churn
+                # leaving it to GC leaks one fd per failed call (the
+                # fd-flat regression test in test_router_wal.py
+                # watches this).  A fully-read keep-alive exchange —
+                # success or mapped error — leaves the socket reusable
+                body_bytes = r.read()
+            except (http.client.HTTPException, ConnectionError,
+                    OSError) as e:
+                conn.close()
+                if reused and attempt == 0:
+                    # the stale keep-alive race: the peer closed this
+                    # idle socket between our calls, nothing was
+                    # processed — retry once on a FRESH connection
+                    continue
+                raise EngineUnavailable(
+                    f"engine {self.name} unreachable: {e}") from e
+            self._release_conn(conn, reusable=not r.will_close)
             body = {}
             try:
-                body = json.loads(e.read())
+                body = json.loads(body_bytes)
             except Exception:  # noqa: BLE001 — non-JSON error body
                 pass
-            finally:
-                e.close()
-            if e.code == 503 and path == "/healthz":
+            code = r.status
+            if code == 200:
+                return body
+            if code == 503 and path == "/healthz":
                 return body or {"ok": False, "status": "degraded"}
-            if e.code == 503:
+            if code == 503:
                 raise Overloaded(
                     body.get("error", "overloaded"),
                     retry_after=float(body.get("retry_after", 0.0)))
-            if e.code == 504:
+            if code == 504:
                 raise DeadlineExpired(body.get("error", "deadline"))
-            if e.code == 400:
+            if code == 400:
                 raise ValueError(body.get("error", "bad request"))
             raise EngineUnavailable(
-                f"engine {self.name}: HTTP {e.code} "
+                f"engine {self.name}: HTTP {code} "
                 f"{body.get('error', '')}")
-        except (urllib.error.URLError, ConnectionError, OSError) as e:
-            raise EngineUnavailable(
-                f"engine {self.name} unreachable: {e}") from e
+        raise EngineUnavailable(f"engine {self.name} unreachable")
 
     def probe(self) -> Dict[str, Any]:
         h = self._call("GET", "/healthz")

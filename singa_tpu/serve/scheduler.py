@@ -124,6 +124,48 @@ class StreamTicket:
             if kind == "tok":
                 yield payload
 
+    def drain_events(self, max_n: int = 1,
+                     timeout: Optional[float] = None,
+                     linger_s: float = 0.0):
+        """Batched drain for the flushed transports (serve/wire.py):
+        block up to `timeout` for the FIRST event, then greedily take
+        whatever is already queued — lingering at most `linger_s` for
+        stragglers — up to `max_n` events per call.  One queue wakeup
+        amortizes over the whole batch instead of one lock round-trip
+        per token.  Returns a list of (kind, payload) tuples ending
+        early at any non-"tok" event; raises the stream's failure and
+        TimeoutError exactly like `events()`.  `max_n=1, linger_s=0`
+        reproduces the unbatched behavior bit-for-bit."""
+        try:
+            evs = [self._q.get(timeout=timeout)]
+        except queue.Empty:
+            raise TimeoutError("stream stalled") from None
+        if evs[0][0] == "err":
+            raise evs[0][1]
+        limit = max(int(max_n), 1)
+        wait_until = (time.monotonic() + max(float(linger_s), 0.0)
+                      if linger_s and linger_s > 0 else None)
+        while len(evs) < limit and evs[-1][0] == "tok":
+            try:
+                if wait_until is None:
+                    ev = self._q.get_nowait()
+                else:
+                    rem = wait_until - time.monotonic()
+                    if rem <= 0:
+                        ev = self._q.get_nowait()
+                    else:
+                        ev = self._q.get(timeout=rem)
+            except queue.Empty:
+                break
+            if ev[0] == "err":
+                # surface the failure only after the caller has
+                # consumed the tokens drained before it: a mid-batch
+                # error must not eat already-produced tokens
+                evs.append(("failed", ev[1]))
+                break
+            evs.append(ev)
+        return evs
+
     def wait(self, timeout: Optional[float] = None) -> Dict[str, Any]:
         if not self._done.wait(timeout):
             raise TimeoutError("request still queued/running")

@@ -64,7 +64,7 @@ import numpy as np
 from .. import obs
 from ..obs import perf
 from ..obs.metrics import MetricsRegistry
-from . import qos
+from . import qos, wire
 from .batcher import DeadlineExpired, MicroBatcher, Overloaded
 from .engine import InferenceEngine, ServeSpec  # noqa: F401 (re-export)
 from .scheduler import ContinuousScheduler, StreamTicket
@@ -82,7 +82,8 @@ class InferenceServer:
                  host: str = "127.0.0.1", port: int = 0,
                  http: bool = True, warmup_modes=("generate",),
                  log_fn=print,
-                 tenancy: Optional[TenantRegistry] = None):
+                 tenancy: Optional[TenantRegistry] = None,
+                 wire_on: bool = False, wire_port: int = 0):
         self.engine = engine
         self.stats = engine.stats
         # ONE tenant registry per server, shared by both admission
@@ -107,7 +108,17 @@ class InferenceServer:
         # every /metrics endpoint — a leaking engine must be visible
         perf.register_into(self.metrics)
         perf.register_process_into(self.metrics)
+        # process-wide binary-transport counters (serve/wire.py) —
+        # same process-global idiom as perf: every server's /metrics
+        # shows the one wire story
+        wire.register_into(self.metrics)
         self._host, self._port = host, port
+        # binary framed listener beside the HTTP frontend; HTTP stays
+        # the always-on debug-and-negotiation surface (/healthz
+        # advertises the wire port)
+        self._wire_wanted = bool(wire_on)
+        self._wire_port = int(wire_port)
+        self._wire: Optional[wire.BinaryTransportServer] = None
         self._http_wanted = http
         self._warmup_modes = tuple(warmup_modes)
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -153,9 +164,16 @@ class InferenceServer:
             self._http_thread.start()
             self.log(f"serve: http on {self.address[0]}:"
                      f"{self.address[1]}")
+        if self._wire_wanted:
+            self._wire = wire.BinaryTransportServer(
+                self, host=self._host, port=self._wire_port,
+                log_fn=self.log).start()
         return self
 
     def stop(self) -> None:
+        if self._wire is not None:
+            self._wire.stop()
+            self._wire = None
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -180,6 +198,12 @@ class InferenceServer:
         """(host, port) of the HTTP frontend (port resolved when the
         constructor asked for 0), or None without HTTP."""
         return self._httpd.server_address if self._httpd else None
+
+    @property
+    def wire_address(self):
+        """(host, port) of the binary framed listener, or None when
+        the server speaks HTTP only."""
+        return self._wire.address if self._wire else None
 
     def _poll_loop(self) -> None:
         """Supervised reload poll: `poll_reload` already contains the
@@ -336,6 +360,12 @@ def _make_handler(server: InferenceServer):
                 self._reply_text(200, server.metrics.render_prometheus())
             elif self.path == "/healthz":
                 h = server.engine.health()
+                # transport negotiation: a healthy worker advertises
+                # its binary listener here; clients that never look
+                # stay on HTTP (the always-on debug surface)
+                wa = server.wire_address
+                if wa is not None:
+                    h["wire_port"] = wa[1]
                 self._reply(200 if h["ok"] else 503, h)
             elif self.path == "/trace":
                 # this worker's span ring (Perfetto dict, carrying
@@ -450,7 +480,13 @@ def _make_handler(server: InferenceServer):
             errors — including an inadmissible resume_from — raise
             BEFORE any byte is sent and take the normal status-code
             path in do_POST; a mid-stream failure becomes a terminal
-            {"error": ...} line (the 200 is already on the wire)."""
+            {"error": ...} line (the 200 is already on the wire).
+
+            Lines are flushed in batches under the spec's
+            flush_tokens/flush_ms knobs (one chunked write carrying
+            several ndjson lines) — except the FIRST token of the
+            stream, which always flushes alone so first-token latency
+            never pays for batching."""
             t0 = time.monotonic()
             ticket = server.scheduler.submit(tokens, timeout=timeout,
                                              max_new=max_new,
@@ -462,23 +498,45 @@ def _make_handler(server: InferenceServer):
             self.send_header("Content-Type", "application/x-ndjson")
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
+            spec = server.engine.spec
+            co = wire.LineCoalescer(
+                self._chunk,
+                flush_tokens=getattr(spec, "flush_tokens", 8),
+                flush_ms=getattr(spec, "flush_ms", 4.0))
             i = ticket.first_index
+            budget = server._wait_budget(timeout, deadline)
+            first = True
             try:
-                for kind, payload in ticket.events(
-                        timeout=server._wait_budget(timeout, deadline)):
-                    if kind == "tok":
-                        line = {"token": payload, "i": i}
-                        i += 1
-                    else:
-                        line = dict(payload)
-                        line["done"] = True
-                        line["latency_ms"] = round(
-                            (time.monotonic() - t0) * 1e3, 3)
-                    self._chunk(json.dumps(line).encode() + b"\n")
+                done = False
+                while not done:
+                    evs = ticket.drain_events(
+                        max_n=1 if first else co.flush_tokens,
+                        timeout=budget,
+                        linger_s=0.0 if first else co.flush_s)
+                    first = False
+                    for kind, payload in evs:
+                        if kind == "tok":
+                            line = {"token": payload, "i": i}
+                            i += 1
+                            co.add(wire.timed_json_dumps(line)
+                                   + b"\n")
+                        elif kind == "failed":
+                            # tokens drained before the failure are
+                            # already queued; flush them, then the
+                            # error line below
+                            raise payload
+                        else:
+                            line = dict(payload)
+                            line["done"] = True
+                            line["latency_ms"] = round(
+                                (time.monotonic() - t0) * 1e3, 3)
+                            co.add(wire.timed_json_dumps(line)
+                                   + b"\n", urgent=True)
+                            done = True
             except Exception as e:  # noqa: BLE001 — mid-stream failure
-                self._chunk(json.dumps(
+                co.add(json.dumps(
                     {"error": f"{type(e).__name__}: {e}"}).encode()
-                    + b"\n")
+                    + b"\n", urgent=True)
             self._chunk(b"")      # terminal 0-length chunk
 
     return Handler

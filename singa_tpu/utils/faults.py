@@ -93,6 +93,15 @@ restart — so a one-shot fault never re-fires during recovery):
                    terminal error: the client sees exactly the old
                    mid-stream RuntimeError, never a hang and never a
                    duplicated token)
+    wire.frame     one outbound binary-transport frame (serve/wire.py
+                   send path — an "error" kind DROPS the frame and
+                   fails the connection, a "corrupt" kind flips bytes
+                   so the receiver counts `wire_malformed_total` and
+                   closes, a silent "torn" kind writes half the frame
+                   then fails the sender.  All three degrade to a
+                   counted reconnect or a per-request failure the
+                   Router's retry/failover machinery absorbs — never
+                   a hang, never an undetected bad payload)
 
 Fault kinds:
 
@@ -135,7 +144,7 @@ SITES = ("data.decode", "data.prefetch", "feed.stage", "ckpt.save",
          "serve.hedge", "engine.stall", "fleet.dispatch",
          "fleet.rollout", "pipeline.publish", "scale.decide",
          "obs.emit", "serve.resume", "obs.flush", "router.wal",
-         "router.recover")
+         "router.recover", "wire.frame")
 
 KINDS = ("error", "preempt", "corrupt", "torn", "nan", "spike",
          "stall")

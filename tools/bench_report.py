@@ -88,6 +88,11 @@ REQUIRED_GATES = {
         "state_quarantine_survived", "state_shed_streak_survived",
         "wal_overhead_ratio", "wal_fault_counted_loss",
     ),
+    "BENCH_pr20.json": (
+        "transport_p50_improved", "transport_ser_time_reduced",
+        "transport_stream_parity", "wire_splice_exactly_once",
+        "wire_fuzz_no_hangs", "wire_fault_absorbed",
+    ),
 }
 
 # --trajectory: tracked keys -> (direction, tolerance factor).  The
@@ -112,6 +117,8 @@ TREND_TOL = {
     "restart_to_training_s": ("lower", None),
     "hbm_watermark_bytes": ("lower", 4.0),
     "mfu": ("higher", 3.0),
+    "transport_p50_ms": ("lower", 3.0),     # binary-path unary p50
+    "binary_ser_us": ("lower", 3.0),        # per-stream wire encode
 }
 
 
